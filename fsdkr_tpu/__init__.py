@@ -1,0 +1,31 @@
+"""fsdkr_tpu — a TPU-native framework with the capabilities of fs-dkr.
+
+One-round Fouque-Stern Distributed Key Refresh for GG20 threshold-ECDSA
+keys (reference: Leo-Li009/fs-dkr, mounted at /root/reference): proactive
+share rotation, party add / replace / remove with identifiable abort, plus
+the full supporting stack the Rust reference pulls from curv /
+kzen-paillier / zk-paillier (Paillier, secp256k1, Feldman VSS,
+PDL-with-slack, Alice/Bob range proofs, ring-Pedersen and correct-key
+proofs).
+
+Design: the protocol layer mirrors the reference API surface
+(`RefreshMessage.{distribute,collect,replace}`, `JoinMessage`), while every
+hot numeric path is expressed as batched, multi-modulus big-integer
+arithmetic over fixed-shape limb tensors so it can run as JAX/Pallas
+kernels on TPU (`fsdkr_tpu.ops`), with a pure-Python host backend as the
+correctness oracle (`backend="host"`).
+"""
+
+from .config import ProtocolConfig, DEFAULT_CONFIG
+from . import errors
+from .errors import FsDkrError
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ProtocolConfig",
+    "DEFAULT_CONFIG",
+    "errors",
+    "FsDkrError",
+    "__version__",
+]

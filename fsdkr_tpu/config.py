@@ -1,0 +1,56 @@
+"""Protocol configuration.
+
+The reference fixes its parameters at compile time
+(`/root/reference/src/lib.rs:26-27`: PAILLIER_KEY_SIZE=2048, M_SECURITY=256,
+plus cargo features selecting the bigint backend, `Cargo.toml:41-44`).
+Here the same knobs are a runtime config object, extended with the
+TPU-specific choices (backend selection and device-mesh shape), mirroring
+the feature-flag pattern with a first-class object instead.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class ProtocolConfig:
+    """All security / execution parameters of the refresh protocol.
+
+    paillier_bits: modulus size of every Paillier key and every ring-Pedersen
+        / h1-h2-N-tilde modulus (reference: PAILLIER_KEY_SIZE=2048,
+        `src/lib.rs:26`). The moduli acceptance gate admits
+        [paillier_bits-1, paillier_bits] bit moduli
+        (`src/refresh_message.rs:385-391`).
+    m_security: number of binary-challenge rounds of the ring-Pedersen
+        parameter proof (reference: M_SECURITY=256, `src/lib.rs:27`).
+    correct_key_rounds: number of Fiat-Shamir challenges of the Paillier
+        correct-key proof (zk-paillier uses 11).
+    backend: "host" (pure-Python oracle) or "tpu" (batched JAX/Pallas
+        verification kernels). Mirrors the reference's bigint feature switch.
+    mesh_shape: optional device-mesh shape for sharded batch verification;
+        None means "use all local devices on one axis".
+    """
+
+    paillier_bits: int = 2048
+    m_security: int = 256
+    correct_key_rounds: int = 11
+    backend: str = "host"
+    mesh_shape: Optional[Tuple[int, ...]] = None
+
+    def with_backend(self, backend: str) -> "ProtocolConfig":
+        return replace(self, backend=backend)
+
+    @property
+    def prime_bits(self) -> int:
+        return self.paillier_bits // 2
+
+
+DEFAULT_CONFIG = ProtocolConfig()
+
+# Small-parameter config for fast tests: 768-bit Paillier moduli are the
+# smallest size at which share recovery is still exact (the Lagrange-weighted
+# plaintext sum is < q^2 * (t+1) ~ 2^520 for secp256k1) while keeping the
+# single-core host oracle fast. Production remains 2048/256.
+TEST_CONFIG = ProtocolConfig(paillier_bits=768, m_security=32, correct_key_rounds=3)

@@ -1,0 +1,9 @@
+"""Host-side cryptographic core: the capability surface the reference gets
+from `curv` (bigint + secp256k1 + Feldman VSS + hashing) and `kzen-paillier`
+(see SURVEY.md §2b). Pure Python over CPython ints — this layer is the
+correctness oracle for the TPU limb kernels in `fsdkr_tpu.ops`.
+"""
+
+from . import intops, primes, transcript, secp256k1, paillier, vss
+
+__all__ = ["intops", "primes", "transcript", "secp256k1", "paillier", "vss"]

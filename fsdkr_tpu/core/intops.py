@@ -1,0 +1,108 @@
+"""Arbitrary-precision integer helpers over CPython ints.
+
+Provides the `curv::BigInt` operation surface the reference consumes
+(SURVEY.md §2b row "Arbitrary/fixed-precision modular arithmetic"):
+mod_pow / mod_inv / mod_mul / sampling / bit_length / byte conversion
+(usage sites e.g. `/root/reference/src/range_proofs.rs:54-63`,
+`src/zk_pdl_with_slack.rs:177-187`). CPython `pow` is the host oracle; the
+TPU limb kernels in `fsdkr_tpu.ops.montgomery` are differential-tested
+against these functions.
+"""
+
+from __future__ import annotations
+
+import math
+import secrets
+
+__all__ = [
+    "mod_pow",
+    "mod_pow_signed",
+    "mod_inv",
+    "mod_mul",
+    "sample_below",
+    "sample_range",
+    "sample_bits",
+    "sample_unit",
+    "bit_length",
+    "to_bytes",
+    "from_bytes",
+    "gcd",
+]
+
+
+def mod_pow(base: int, exp: int, modulus: int) -> int:
+    """base^exp mod modulus for exp >= 0."""
+    return pow(base, exp, modulus)
+
+
+def mod_pow_signed(base: int, exp: int, modulus: int) -> int:
+    """base^exp mod modulus, handling negative exponents via modular inverse.
+
+    Mirrors the negative-exponent branch of `commitment_unknown_order`
+    (`/root/reference/src/zk_pdl_with_slack.rs:178-185`).
+    """
+    if exp < 0:
+        inv = mod_inv(base, modulus)
+        if inv is None:
+            raise ValueError("base not invertible for negative exponent")
+        return pow(inv, -exp, modulus)
+    return pow(base, exp, modulus)
+
+
+def mod_inv(x: int, modulus: int):
+    """Modular inverse, or None when gcd(x, modulus) != 1 (the reference's
+    `BigInt::mod_inv` returns Option)."""
+    try:
+        return pow(x, -1, modulus)
+    except ValueError:
+        return None
+
+
+def mod_mul(a: int, b: int, modulus: int) -> int:
+    return (a * b) % modulus
+
+
+def sample_below(bound: int) -> int:
+    """Uniform sample in [0, bound)."""
+    if bound <= 0:
+        raise ValueError("bound must be positive")
+    return secrets.randbelow(bound)
+
+
+def sample_range(lo: int, hi: int) -> int:
+    """Uniform sample in [lo, hi)."""
+    return lo + secrets.randbelow(hi - lo)
+
+
+def sample_bits(bits: int) -> int:
+    return secrets.randbits(bits)
+
+
+def sample_unit(modulus: int) -> int:
+    """Uniform sample from the multiplicative group Z_modulus^* (rejection
+    sampling, reference `SampleFromMultiplicativeGroup`
+    `/root/reference/src/range_proofs.rs:598-612`)."""
+    while True:
+        r = secrets.randbelow(modulus)
+        if r and math.gcd(r, modulus) == 1:
+            return r
+
+
+def bit_length(x: int) -> int:
+    return x.bit_length()
+
+
+def to_bytes(x: int) -> bytes:
+    """Minimal big-endian magnitude bytes; 0 encodes as b'' (matching the
+    transcript convention in fsdkr_tpu.core.transcript)."""
+    if x < 0:
+        raise ValueError("to_bytes takes non-negative integers")
+    return x.to_bytes((x.bit_length() + 7) // 8, "big")
+
+
+def from_bytes(b: bytes) -> int:
+    return int.from_bytes(b, "big")
+
+
+def gcd(a: int, b: int) -> int:
+    return math.gcd(a, b)

@@ -1,0 +1,103 @@
+"""Paillier cryptosystem (host oracle).
+
+Capability surface of `kzen-paillier` as consumed by the reference
+(SURVEY.md §2b): `keypair_with_modulus_size(bits)`, encryption with chosen
+randomness `(1+n)^m * r^n mod n^2`, homomorphic add (ciphertext x
+ciphertext) and mul (ciphertext x plaintext), CRT decryption with
+`dk = {p, q}` (usage `/root/reference/src/refresh_message.rs:72-84,118,
+221-236,439`).
+
+The TPU path batches enc / homomorphic ops / the verification modexps over
+limb tensors (`fsdkr_tpu.ops`); keygen stays host-side (SURVEY.md §7 step 3).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from . import intops, primes
+
+__all__ = ["EncryptionKey", "DecryptionKey", "keygen", "encrypt", "encrypt_with_randomness", "decrypt", "add", "mul", "sample_randomness"]
+
+
+@dataclass(frozen=True)
+class EncryptionKey:
+    """Public key; field names mirror the reference's `EncryptionKey{n, nn}`
+    (`/root/reference/src/add_party_message.rs:248-251`)."""
+
+    n: int
+    nn: int
+
+    @staticmethod
+    def from_n(n: int) -> "EncryptionKey":
+        return EncryptionKey(n=n, nn=n * n)
+
+
+@dataclass
+class DecryptionKey:
+    """Secret key; `DecryptionKey{p, q}` as in the reference. Mutable so the
+    protocol can zeroize it on refresh
+    (`/root/reference/src/refresh_message.rs:446-448`)."""
+
+    p: int
+    q: int
+
+    def zeroize(self) -> None:
+        self.p = 0
+        self.q = 0
+
+
+def keygen(modulus_bits: int) -> tuple[EncryptionKey, DecryptionKey]:
+    n, p, q = primes.gen_modulus(modulus_bits)
+    return EncryptionKey.from_n(n), DecryptionKey(p=p, q=q)
+
+
+def sample_randomness(ek: EncryptionKey) -> int:
+    return intops.sample_unit(ek.n)
+
+
+def encrypt_with_randomness(ek: EncryptionKey, m: int, r: int) -> int:
+    """c = (1+n)^m * r^n mod n^2, with (1+n)^m computed as 1 + m*n mod n^2.
+
+    r must be a unit of Z_n; a zero / non-unit r would make the ciphertext
+    undecryptable garbage rather than fail loudly.
+    """
+    if r <= 0 or math.gcd(r, ek.n) != 1:
+        raise ValueError("Paillier randomness must be a unit of Z_n")
+    gm = (1 + (m % ek.n) * ek.n) % ek.nn
+    return (gm * pow(r, ek.n, ek.nn)) % ek.nn
+
+
+def encrypt(ek: EncryptionKey, m: int) -> int:
+    return encrypt_with_randomness(ek, m, sample_randomness(ek))
+
+
+def decrypt(dk: DecryptionKey, ek: EncryptionKey, c: int) -> int:
+    """CRT decryption: m = L(c^lambda mod n^2) * lambda^{-1} mod n, done
+    separately mod p^2 and q^2 and recombined."""
+    p, q = dk.p, dk.q
+    if p == 0 or q == 0:
+        raise ValueError("decryption key has been zeroized")
+    n = p * q
+    pp, qq = p * p, q * q
+    # With g = 1+n: L_p(g^{p-1} mod p^2) = (p-1)*q mod p, so the CRT
+    # correction factor is h_p = ((p-1)*q)^{-1} mod p (and symmetrically q).
+    hp = pow((p - 1) * q % p, -1, p)
+    hq = pow((q - 1) * p % q, -1, q)
+    mp = ((pow(c % pp, p - 1, pp) - 1) // p) * hp % p
+    mq = ((pow(c % qq, q - 1, qq) - 1) // q) * hq % q
+    # CRT combine
+    qinv = pow(q, -1, p)
+    diff = (mp - mq) * qinv % p
+    return (mq + diff * q) % n
+
+
+def add(ek: EncryptionKey, c1: int, c2: int) -> int:
+    """Homomorphic addition: Enc(m1) (+) Enc(m2) = c1*c2 mod n^2."""
+    return (c1 * c2) % ek.nn
+
+
+def mul(ek: EncryptionKey, c: int, k: int) -> int:
+    """Homomorphic scalar multiplication: Enc(m) (*) k = c^k mod n^2."""
+    return pow(c, k % ek.n, ek.nn)

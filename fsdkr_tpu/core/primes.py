@@ -1,0 +1,88 @@
+"""Prime generation for Paillier / ring-Pedersen moduli.
+
+The reference delegates to GMP through `kzen-paillier`'s
+`keypair_with_modulus_size` (`/root/reference/src/refresh_message.rs:118`).
+Host-serial work stays host-side here (SURVEY.md §7 step 3): a small-prime
+sieve plus Miller-Rabin over CPython ints. Generation cost is amortized —
+keygen happens once per refresh per party, while verification is O(n²).
+"""
+
+from __future__ import annotations
+
+import math
+import secrets
+
+__all__ = ["is_probable_prime", "gen_prime", "gen_modulus"]
+
+# Product of odd primes below 4000 — one gcd against a candidate rejects
+# nearly all composites before any modexp is spent on Miller-Rabin.
+def _primorial(limit: int = 4000) -> int:
+    sieve = bytearray([1]) * limit
+    sieve[0:2] = b"\x00\x00"
+    for i in range(2, int(limit**0.5) + 1):
+        if sieve[i]:
+            sieve[i * i :: i] = b"\x00" * len(sieve[i * i :: i])
+    out = 1
+    for p in range(3, limit):
+        if sieve[p]:
+            out *= p
+    return out
+
+
+_PRIMORIAL = _primorial()
+
+
+def is_probable_prime(n: int, rounds: int = 30) -> bool:
+    """Miller-Rabin with `rounds` random bases (error <= 4^-rounds)."""
+    if n < 2:
+        return False
+    for small in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37):
+        if n % small == 0:
+            return n == small
+    d = n - 1
+    r = (d & -d).bit_length() - 1
+    d >>= r
+    for _ in range(rounds):
+        a = 2 + secrets.randbelow(n - 3)
+        x = pow(a, d, n)
+        if x == 1 or x == n - 1:
+            continue
+        for _ in range(r - 1):
+            x = (x * x) % n
+            if x == n - 1:
+                break
+        else:
+            return False
+    return True
+
+
+def gen_prime(bits: int) -> int:
+    """Random prime with exactly `bits` bits and the top two bits set.
+
+    Forcing the two leading bits guarantees a product of two such primes has
+    exactly 2*bits bits, satisfying the reference's moduli acceptance gate of
+    [2*bits - 1, 2*bits] (`/root/reference/src/refresh_message.rs:385-391`).
+    """
+    if bits < 8:
+        raise ValueError("prime too small")
+    while True:
+        cand = secrets.randbits(bits) | (1 << (bits - 1)) | (1 << (bits - 2)) | 1
+        if math.gcd(cand, _PRIMORIAL) != 1:
+            continue
+        # one cheap round first: almost every sieved composite dies here
+        if not is_probable_prime(cand, rounds=1):
+            continue
+        if is_probable_prime(cand, rounds=29):
+            return cand
+
+
+def gen_modulus(modulus_bits: int) -> tuple[int, int, int]:
+    """Generate (n, p, q) with n = p*q of `modulus_bits` bits, p != q."""
+    if modulus_bits % 2:
+        raise ValueError("modulus_bits must be even")
+    half = modulus_bits // 2
+    p = gen_prime(half)
+    while True:
+        q = gen_prime(half)
+        if q != p:
+            return p * q, p, q

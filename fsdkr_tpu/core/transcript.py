@@ -1,0 +1,72 @@
+"""Fiat-Shamir transcript hashing (SHA-256).
+
+Provides the `curv` `Digest`/`DigestExt` capability the reference uses for
+every NIZK challenge (`chain_bigint` / `result_bigint`, usage e.g.
+`/root/reference/src/range_proofs.rs:150-157`,
+`src/zk_pdl_with_slack.rs:87-95`, `src/ring_pedersen_proof.rs:96-105`).
+
+This framework defines its own canonical encoding (SURVEY.md §7 step 2):
+each chained value is hashed as a 4-byte big-endian length prefix followed
+by its minimal big-endian magnitude bytes. The length prefix removes the
+concatenation ambiguity of the reference's raw-byte chaining; prover and
+verifier only ever need to agree with each other, not with the Rust wire
+format.
+
+Challenge-bit extraction replicates the reference's semantics
+(`bitvec` Lsb0 over the digest bytes, `src/ring_pedersen_proof.rs:106,136`):
+bit i of the challenge is bit (i % 8) of digest byte (i // 8), with the
+digest taken as exactly 32 big-endian bytes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+__all__ = ["Transcript", "hash_ints", "challenge_bits"]
+
+
+class Transcript:
+    """SHA-256 transcript over a sequence of non-negative integers / bytes."""
+
+    def __init__(self, domain: bytes = b""):
+        self._h = hashlib.sha256()
+        if domain:
+            self.chain_bytes(domain)
+
+    def chain_bytes(self, b: bytes) -> "Transcript":
+        self._h.update(len(b).to_bytes(4, "big"))
+        self._h.update(b)
+        return self
+
+    def chain_int(self, x: int) -> "Transcript":
+        if x < 0:
+            raise ValueError("transcript integers must be non-negative")
+        return self.chain_bytes(x.to_bytes((x.bit_length() + 7) // 8, "big"))
+
+    def chain_point(self, point) -> "Transcript":
+        """Chain a curve point via its compressed encoding, as the reference
+        hashes `to_bytes(true)` (`src/zk_pdl_with_slack.rs:88-92`)."""
+        return self.chain_bytes(point.to_bytes(compressed=True))
+
+    def result_int(self) -> int:
+        return int.from_bytes(self._h.digest(), "big")
+
+    def result_bytes(self) -> bytes:
+        return self._h.digest()
+
+
+def hash_ints(values, domain: bytes = b"") -> int:
+    t = Transcript(domain)
+    for v in values:
+        t.chain_int(v)
+    return t.result_int()
+
+
+def challenge_bits(e: int, m: int) -> list[int]:
+    """Extract m binary challenges from challenge integer e, Lsb0 order over
+    the 32-byte big-endian digest representation
+    (reference: `src/ring_pedersen_proof.rs:106`)."""
+    if m > 256:
+        raise ValueError("SHA-256 transcripts yield at most 256 challenge bits")
+    raw = e.to_bytes(32, "big")
+    return [(raw[i >> 3] >> (i & 7)) & 1 for i in range(m)]

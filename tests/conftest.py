@@ -1,0 +1,29 @@
+"""Test configuration.
+
+Multi-chip sharding is tested on a virtual 8-device CPU mesh: JAX is forced
+onto the CPU platform with 8 host devices before any test imports JAX, so
+`jax.sharding.Mesh`/`shard_map` paths compile and execute without TPU
+hardware. The single real TPU chip is exercised by bench.py, not the unit
+suite.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from fsdkr_tpu.config import TEST_CONFIG  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def test_config():
+    """Reduced-size parameters (768-bit Paillier, M=32) so the single-core
+    host oracle runs the full protocol in seconds; full-size runs are marked
+    `slow`."""
+    return TEST_CONFIG

@@ -1,0 +1,155 @@
+"""Unit tests for the host crypto core (oracle layer)."""
+
+import secrets
+
+import pytest
+
+from fsdkr_tpu.core import intops, paillier, primes, secp256k1, transcript, vss
+from fsdkr_tpu.core.secp256k1 import GENERATOR, N, Point, Scalar
+
+
+class TestIntops:
+    def test_mod_inv(self):
+        m = 101
+        for x in range(1, 20):
+            inv = intops.mod_inv(x, m)
+            assert (x * inv) % m == 1
+        assert intops.mod_inv(6, 12) is None
+
+    def test_mod_pow_signed_negative(self):
+        m = 10007
+        x = 1234
+        assert intops.mod_pow_signed(x, -5, m) == pow(pow(x, -1, m), 5, m)
+
+    def test_bytes_roundtrip(self):
+        for _ in range(20):
+            x = secrets.randbits(517)
+            assert intops.from_bytes(intops.to_bytes(x)) == x
+
+    def test_sample_unit_coprime(self):
+        n = 15 * 77
+        for _ in range(10):
+            assert intops.gcd(intops.sample_unit(n), n) == 1
+
+
+class TestPrimes:
+    def test_small_primality(self):
+        known = {2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37, 41, 43, 47}
+        for n in range(2, 50):
+            assert primes.is_probable_prime(n) == (n in known)
+
+    def test_gen_prime_bits(self):
+        p = primes.gen_prime(128)
+        assert p.bit_length() == 128
+        assert primes.is_probable_prime(p)
+
+    def test_gen_modulus_exact_bits(self):
+        n, p, q = primes.gen_modulus(256)
+        assert n == p * q
+        assert n.bit_length() == 256
+
+
+class TestTranscript:
+    def test_deterministic_and_length_prefixed(self):
+        a = transcript.hash_ints([1, 2, 3])
+        b = transcript.hash_ints([1, 2, 3])
+        assert a == b
+        # length prefixing: (0x0102, 0x03) != (0x01, 0x0203)
+        t1 = transcript.Transcript().chain_int(0x0102).chain_int(0x03).result_int()
+        t2 = transcript.Transcript().chain_int(0x01).chain_int(0x0203).result_int()
+        assert t1 != t2
+
+    def test_challenge_bits_lsb0(self):
+        # e with known byte layout: first byte of the 32-byte BE digest is 0xA5
+        e = 0xA5 << 248
+        bits = transcript.challenge_bits(e, 8)
+        # 0xA5 = 0b10100101, Lsb0 -> [1,0,1,0,0,1,0,1]
+        assert bits == [1, 0, 1, 0, 0, 1, 0, 1]
+
+    def test_challenge_bits_count(self):
+        bits = transcript.challenge_bits(transcript.hash_ints([7]), 256)
+        assert len(bits) == 256
+        assert set(bits) <= {0, 1}
+
+
+class TestSecp256k1:
+    def test_generator_on_curve(self):
+        g = GENERATOR
+        assert (g.y * g.y - (g.x**3 + 7)) % secp256k1.P == 0
+
+    def test_group_law(self):
+        a, b = Scalar.random(), Scalar.random()
+        assert GENERATOR * a + GENERATOR * b == GENERATOR * (a + b)
+        assert GENERATOR * a - GENERATOR * a == Point.identity()
+
+    def test_order(self):
+        assert GENERATOR * N == Point.identity()
+        assert GENERATOR * (N + 1) == GENERATOR
+
+    def test_compressed_roundtrip(self):
+        p = GENERATOR * Scalar.random()
+        assert Point.from_bytes(p.to_bytes(compressed=True)) == p
+        assert Point.from_bytes(Point.identity().to_bytes()) == Point.identity()
+
+    def test_scalar_inverse(self):
+        s = Scalar.random()
+        assert (s * s.invert()).v == 1
+
+
+class TestPaillier:
+    @pytest.fixture(scope="class")
+    def keypair(self):
+        return paillier.keygen(512)
+
+    def test_roundtrip(self, keypair):
+        ek, dk = keypair
+        m = secrets.randbelow(ek.n)
+        assert paillier.decrypt(dk, ek, paillier.encrypt(ek, m)) == m
+
+    def test_homomorphic_add_mul(self, keypair):
+        # mirrors the MtA algebra of the reference's bob_zkp test
+        # (/root/reference/src/range_proofs.rs:676-744)
+        ek, dk = keypair
+        a = secrets.randbelow(1 << 128)
+        b = secrets.randbelow(1 << 64)
+        c = secrets.randbelow(1 << 128)
+        enc_a = paillier.encrypt(ek, a)
+        ab = paillier.mul(ek, enc_a, b)
+        ab_plus_c = paillier.add(ek, ab, paillier.encrypt(ek, c))
+        assert paillier.decrypt(dk, ek, ab_plus_c) == (a * b + c) % ek.n
+
+    def test_chosen_randomness_deterministic(self, keypair):
+        ek, _ = keypair
+        r = paillier.sample_randomness(ek)
+        assert paillier.encrypt_with_randomness(ek, 42, r) == paillier.encrypt_with_randomness(ek, 42, r)
+
+    def test_zeroized_dk_refuses(self, keypair):
+        ek, _ = keypair
+        dk = paillier.DecryptionKey(p=0, q=0)
+        with pytest.raises(ValueError):
+            paillier.decrypt(dk, ek, 123)
+
+
+class TestVSS:
+    def test_share_validate_reconstruct(self):
+        secret = Scalar.random()
+        scheme, shares = vss.share(2, 5, secret)
+        for i, s in enumerate(shares):
+            assert scheme.validate_share_public(GENERATOR * s, i + 1)
+        # reconstruct from any t+1 shares
+        assert scheme.reconstruct([0, 2, 4], [shares[0], shares[2], shares[4]]).v == secret.v
+        assert scheme.reconstruct([1, 2, 3], [shares[1], shares[2], shares[3]]).v == secret.v
+
+    def test_validate_rejects_wrong_share(self):
+        scheme, shares = vss.share(1, 3, Scalar.random())
+        bad = GENERATOR * (shares[0] + Scalar.from_int(1))
+        assert not scheme.validate_share_public(bad, 1)
+
+    def test_lagrange_identity(self):
+        params = vss.ShamirSecretSharing(2, 5)
+        s = [0, 2, 4]
+        total = Scalar.zero()
+        # sum of lagrange basis coefficients at 0 equals 1
+        for idx in s:
+            total = total + vss.map_share_to_new_params(params, idx, s)
+        assert total.v == 1
