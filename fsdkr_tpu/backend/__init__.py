@@ -1,0 +1,20 @@
+"""Verification backends.
+
+The reference verifies every proof serially inside `collect`'s O(n^2) loop
+(`/root/reference/src/refresh_message.rs:330-350`). This framework instead
+*gathers* all proof instances of a collect into per-family batches and
+dispatches them to a backend (SURVEY.md §7 step 7):
+
+- "host": the pure-Python oracle — verifies each instance with the proofs
+  module; ground truth for differential tests.
+- "tpu": batched multi-modulus modexp / EC kernels over limb tensors
+  (fsdkr_tpu.ops), one launch per proof family.
+
+Both return *per-instance verdicts* (never early-exit), so identifiable
+abort attribution — mapping a failing batch row back to the offending
+party — is preserved exactly (`src/error.rs` semantics).
+"""
+
+from .batch_verifier import BatchVerifier, HostBatchVerifier, get_backend
+
+__all__ = ["BatchVerifier", "HostBatchVerifier", "get_backend"]

@@ -39,6 +39,19 @@ class ProtocolConfig:
     backend: str = "host"
     mesh_shape: Optional[Tuple[int, ...]] = None
 
+    def __post_init__(self):
+        # Share recovery is only exact when the Lagrange-weighted plaintext
+        # sum (t+1 terms, each < q^2 ~ 2^512 for secp256k1) cannot wrap mod
+        # the Paillier modulus; 640 bits leaves 128 bits of committee-size
+        # headroom. collect() additionally checks the recovered share
+        # against the Feldman commitments.
+        if self.paillier_bits < 640:
+            raise ValueError("paillier_bits must be >= 640 for exact share recovery")
+        if self.paillier_bits % 2:
+            raise ValueError("paillier_bits must be even")
+        if not 0 < self.m_security <= 256:
+            raise ValueError("m_security must be in (0, 256]")
+
     def with_backend(self, backend: str) -> "ProtocolConfig":
         return replace(self, backend=backend)
 

@@ -1,0 +1,44 @@
+"""Zero-knowledge proof layer (SURVEY.md §2a L3).
+
+Six proof systems, each with prove/generate + verify and a soundness
+negative test in tests/test_proofs.py:
+
+- alice_range: Paillier ciphertext encrypts a value < q^3 (slack range)
+  — reference `src/range_proofs.rs` AliceProof.
+- bob_range: Bob's MtA / MtAwc proofs — protocol-dead in the reference
+  (SURVEY.md §5 quirk 9) but part of the capability surface.
+- pdl_slack: ciphertext and EC point hide the same x — reference
+  `src/zk_pdl_with_slack.rs`.
+- ring_pedersen: well-formedness of ring-Pedersen parameters (S = T^lambda)
+  — reference `src/ring_pedersen_proof.rs`.
+- composite_dlog: discrete log over Z_N-tilde^* (zk-paillier
+  CompositeDLogProof equivalent).
+- correct_key: Paillier key correctness via N-th roots (zk-paillier
+  NiCorrectKeyProof equivalent).
+
+Every verifier here is the host oracle; the batched TPU verifiers in
+`fsdkr_tpu.backend` evaluate the same equations over limb tensors.
+"""
+
+from .composite_dlog import DLogStatement, CompositeDLogProof
+from .alice_range import AliceProof
+from .bob_range import BobProof, BobProofExt
+from .pdl_slack import PDLwSlackStatement, PDLwSlackWitness, PDLwSlackProof
+from .ring_pedersen import RingPedersenStatement, RingPedersenWitness, RingPedersenProof
+from .correct_key import NiCorrectKeyProof, SALT_STRING
+
+__all__ = [
+    "DLogStatement",
+    "CompositeDLogProof",
+    "AliceProof",
+    "BobProof",
+    "BobProofExt",
+    "PDLwSlackStatement",
+    "PDLwSlackWitness",
+    "PDLwSlackProof",
+    "RingPedersenStatement",
+    "RingPedersenWitness",
+    "RingPedersenProof",
+    "NiCorrectKeyProof",
+    "SALT_STRING",
+]

@@ -1,0 +1,118 @@
+"""Alice's range proof: a Paillier ciphertext encrypts a value in the slack
+range [0, q^3).
+
+Re-derivation of the reference's `AliceProof`
+(`/root/reference/src/range_proofs.rs:40-203`; GG19 Appendix-A MtA proof,
+non-interactive via Fiat-Shamir). Notation matches the reference:
+
+  prover (secret a < q, randomness r of c = Enc_ek(a, r)):
+    alpha < q^3, beta <- Z_n^*, gamma < q^3*Ntilde, rho < q*Ntilde
+    z = h1^a  h2^rho   mod Ntilde
+    u = (1 + alpha*n) beta^n mod n^2          (= Enc(alpha, beta))
+    w = h1^alpha h2^gamma mod Ntilde
+    e = H(n, n+1, c, z, u, w)
+    s = r^e beta mod n; s1 = e*a + alpha; s2 = e*rho + gamma
+
+  verifier: reject if s1 > q^3; recompute
+    w' = h1^s1 h2^s2 (z^e)^{-1} mod Ntilde
+    u' = (1 + s1*n) s^n (c^e)^{-1} mod n^2
+    accept iff H(n, n+1, c, z, u', w') == e
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+
+from ..core import intops
+from ..core.paillier import EncryptionKey
+from ..core.secp256k1 import N as CURVE_ORDER
+from ..core.transcript import Transcript
+from .composite_dlog import DLogStatement
+
+__all__ = ["AliceProof"]
+
+_DOMAIN = b"fsdkr/alice-range/v1"
+
+
+def _challenge(n: int, c: int, z: int, u: int, w: int) -> int:
+    # transcript fields mirror /root/reference/src/range_proofs.rs:150-157
+    return (
+        Transcript(_DOMAIN)
+        .chain_int(n)
+        .chain_int(n + 1)
+        .chain_int(c)
+        .chain_int(z)
+        .chain_int(u)
+        .chain_int(w)
+        .result_int()
+    )
+
+
+@dataclass(frozen=True)
+class AliceProof:
+    z: int
+    e: int
+    s: int
+    s1: int
+    s2: int
+
+    @staticmethod
+    def generate(
+        a: int,
+        cipher: int,
+        alice_ek: EncryptionKey,
+        dlog_statement: DLogStatement,
+        r: int,
+        q: int = CURVE_ORDER,
+    ) -> "AliceProof":
+        if q.bit_length() > 256:
+            raise ValueError("SHA-256 transcripts support group orders up to 256 bits")
+        h1, h2, n_tilde = dlog_statement.g, dlog_statement.ni, dlog_statement.N
+        n, nn = alice_ek.n, alice_ek.nn
+        q3 = q**3
+
+        alpha = secrets.randbelow(q3)
+        beta = intops.sample_unit(n)
+        gamma = secrets.randbelow(q3 * n_tilde)
+        rho = secrets.randbelow(q * n_tilde)
+
+        z = pow(h1, a, n_tilde) * pow(h2, rho, n_tilde) % n_tilde
+        u = (1 + alpha * n) * pow(beta, n, nn) % nn
+        w = pow(h1, alpha, n_tilde) * pow(h2, gamma, n_tilde) % n_tilde
+
+        e = _challenge(n, cipher, z, u, w)
+        return AliceProof(
+            z=z,
+            e=e,
+            s=pow(r, e, n) * beta % n,
+            s1=e * a + alpha,
+            s2=e * rho + gamma,
+        )
+
+    def verify(
+        self,
+        cipher: int,
+        alice_ek: EncryptionKey,
+        dlog_statement: DLogStatement,
+        q: int = CURVE_ORDER,
+    ) -> bool:
+        h1, h2, n_tilde = dlog_statement.g, dlog_statement.ni, dlog_statement.N
+        n, nn = alice_ek.n, alice_ek.nn
+
+        # range gate (/root/reference/src/range_proofs.rs:125)
+        if self.s1 > q**3 or self.s1 < 0:
+            return False
+
+        z_e_inv = intops.mod_inv(pow(self.z, self.e, n_tilde), n_tilde)
+        if z_e_inv is None:
+            return False
+        w = pow(h1, self.s1, n_tilde) * pow(h2, self.s2, n_tilde) * z_e_inv % n_tilde
+
+        cipher_e_inv = intops.mod_inv(pow(cipher, self.e, nn), nn)
+        if cipher_e_inv is None:
+            return False
+        gs1 = (1 + self.s1 * n) % nn
+        u = gs1 * pow(self.s, n, nn) * cipher_e_inv % nn
+
+        return _challenge(n, cipher, self.z, u, w) == self.e

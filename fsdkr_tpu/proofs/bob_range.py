@@ -1,0 +1,222 @@
+"""Bob's MtA / MtAwc range proofs.
+
+Re-derivation of the reference's `BobProof` / `BobProofExt`
+(`/root/reference/src/range_proofs.rs:206-590`). These are protocol-dead in
+the refresh itself (SURVEY.md §5 quirk 9 — kept for GG20 MtA
+compatibility) but are part of the capability surface, and this framework's
+GG20-style signing harness (`fsdkr_tpu.protocol.signing`) actually uses the
+MtA algebra they attest to.
+
+Statement: Alice's ciphertext c_a = Enc_ek(a), MtA output
+c_out = b * c_a (+) Enc_ek(beta_prim, r). Bob proves b < q^3 (slack) and
+consistency; the Ext variant additionally proves knowledge of b behind
+X = b*G.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+from typing import Optional
+
+from ..core import intops
+from ..core.paillier import EncryptionKey
+from ..core.secp256k1 import N as CURVE_ORDER
+from ..core.secp256k1 import Point, Scalar
+from ..core.transcript import Transcript
+from .composite_dlog import DLogStatement
+
+__all__ = ["BobProof", "BobProofExt"]
+
+_DOMAIN = b"fsdkr/bob-range/v1"
+
+
+def _challenge(
+    n: int,
+    a_enc: int,
+    mta_out: int,
+    z: int,
+    z_prim: int,
+    t: int,
+    v: int,
+    w: int,
+    check: Optional[tuple[Point, Point]],
+) -> int:
+    # transcript fields mirror /root/reference/src/range_proofs.rs:415-439
+    tr = (
+        Transcript(_DOMAIN)
+        .chain_int(n)
+        .chain_int(n + 1)
+        .chain_int(a_enc)
+        .chain_int(mta_out)
+        .chain_int(z)
+        .chain_int(z_prim)
+        .chain_int(t)
+        .chain_int(v)
+        .chain_int(w)
+    )
+    if check is not None:
+        X, u = check
+        tr.chain_int(X.x_coord()).chain_int(X.y_coord())
+        tr.chain_int(u.x_coord()).chain_int(u.y_coord())
+    return tr.result_int()
+
+
+@dataclass(frozen=True)
+class BobProof:
+    t: int
+    z: int
+    e: int
+    s: int
+    s1: int
+    s2: int
+    t1: int
+    t2: int
+
+    @staticmethod
+    def generate(
+        a_encrypted: int,
+        mta_encrypted: int,
+        b: Scalar,
+        beta_prim: int,
+        alice_ek: EncryptionKey,
+        dlog_statement: DLogStatement,
+        r: int,
+        check: bool = False,
+    ) -> tuple["BobProof", Optional[Point]]:
+        q = CURVE_ORDER
+        h1, h2, n_tilde = dlog_statement.g, dlog_statement.ni, dlog_statement.N
+        n, nn = alice_ek.n, alice_ek.nn
+        b_int = b.to_int()
+
+        # round 1 (reference :245-301); gamma/tau ranges per the reference's
+        # documented deviation (range_proofs.rs:9)
+        alpha = secrets.randbelow(q**3)
+        beta = intops.sample_unit(n)
+        gamma = secrets.randbelow(q**2 * n)
+        rho = secrets.randbelow(q * n_tilde)
+        rho_prim = secrets.randbelow(q**3 * n_tilde)
+        sigma = secrets.randbelow(q * n_tilde)
+        tau = secrets.randbelow(q**3 * n_tilde)
+
+        z = pow(h1, b_int, n_tilde) * pow(h2, rho, n_tilde) % n_tilde
+        z_prim = pow(h1, alpha, n_tilde) * pow(h2, rho_prim, n_tilde) % n_tilde
+        t = pow(h1, beta_prim, n_tilde) * pow(h2, sigma, n_tilde) % n_tilde
+        w = pow(h1, gamma, n_tilde) * pow(h2, tau, n_tilde) % n_tilde
+        v = (
+            pow(a_encrypted, alpha, nn)
+            * ((1 + gamma * n) % nn)
+            * pow(beta, n, nn)
+            % nn
+        )
+
+        check_pair = None
+        u_point = None
+        if check:
+            X = Point.generator() * b
+            u_point = Point.generator() * Scalar.from_int(alpha)
+            check_pair = (X, u_point)
+
+        e = _challenge(n, a_encrypted, mta_encrypted, z, z_prim, t, v, w, check_pair)
+
+        # round 2 (reference :313-336)
+        return (
+            BobProof(
+                t=t,
+                z=z,
+                e=e,
+                s=pow(r, e, n) * beta % n,
+                s1=e * b_int + alpha,
+                s2=e * rho + rho_prim,
+                t1=e * beta_prim + gamma,
+                t2=e * sigma + tau,
+            ),
+            u_point,
+        )
+
+    def verify(
+        self,
+        a_enc: int,
+        mta_avc_out: int,
+        alice_ek: EncryptionKey,
+        dlog_statement: DLogStatement,
+        check: Optional[tuple[Point, Point]] = None,
+    ) -> bool:
+        q = CURVE_ORDER
+        h1, h2, n_tilde = dlog_statement.g, dlog_statement.ni, dlog_statement.N
+        n, nn = alice_ek.n, alice_ek.nn
+
+        if self.s1 > q**3 or self.s1 < 0:
+            return False
+
+        z_e_inv = intops.mod_inv(pow(self.z, self.e, n_tilde), n_tilde)
+        if z_e_inv is None:
+            return False
+        z_prim = pow(h1, self.s1, n_tilde) * pow(h2, self.s2, n_tilde) * z_e_inv % n_tilde
+
+        mta_e_inv = intops.mod_inv(pow(mta_avc_out, self.e, nn), nn)
+        if mta_e_inv is None:
+            return False
+        v = (
+            pow(a_enc, self.s1, nn)
+            * pow(self.s, n, nn)
+            * ((1 + self.t1 * n) % nn)
+            * mta_e_inv
+            % nn
+        )
+
+        t_e_inv = intops.mod_inv(pow(self.t, self.e, n_tilde), n_tilde)
+        if t_e_inv is None:
+            return False
+        w = pow(h1, self.t1, n_tilde) * pow(h2, self.t2, n_tilde) * t_e_inv % n_tilde
+
+        return _challenge(n, a_enc, mta_avc_out, self.z, z_prim, self.t, v, w, check) == self.e
+
+
+@dataclass(frozen=True)
+class BobProofExt:
+    """Bob's proof extended with knowledge of B = b*G
+    (reference `src/range_proofs.rs:518-590`)."""
+
+    proof: BobProof
+    u: Point
+
+    @staticmethod
+    def generate(
+        a_encrypted: int,
+        mta_encrypted: int,
+        b: Scalar,
+        beta_prim: int,
+        alice_ek: EncryptionKey,
+        dlog_statement: DLogStatement,
+        r: int,
+    ) -> "BobProofExt":
+        proof, u = BobProof.generate(
+            a_encrypted,
+            mta_encrypted,
+            b,
+            beta_prim,
+            alice_ek,
+            dlog_statement,
+            r,
+            check=True,
+        )
+        assert u is not None
+        return BobProofExt(proof=proof, u=u)
+
+    def verify(
+        self,
+        a_enc: int,
+        mta_avc_out: int,
+        alice_ek: EncryptionKey,
+        dlog_statement: DLogStatement,
+        X: Point,
+    ) -> bool:
+        if not self.proof.verify(
+            a_enc, mta_avc_out, alice_ek, dlog_statement, check=(X, self.u)
+        ):
+            return False
+        # EC consistency: s1*G == e*X + u (reference :549-560)
+        x1 = Point.generator() * Scalar.from_int(self.proof.s1)
+        x2 = X * Scalar.from_int(self.proof.e) + self.u
+        return x1 == x2

@@ -1,0 +1,135 @@
+"""PDL-with-slack proof: a Paillier ciphertext c = Enc_ek(x, r) and an EC
+point Q = x*G hide the same x, with range slack x in [-q^3, q^3].
+
+Re-derivation of the reference's `PDLwSlackProof`
+(`/root/reference/src/zk_pdl_with_slack.rs`, following eprint 2016/013 PIi):
+
+  prover (witness x < q, r):
+    alpha < q^3, beta <- [1, n), rho < q*Ntilde, gamma < q^3*Ntilde
+    z  = h1^x h2^rho mod Ntilde
+    u1 = alpha * G
+    u2 = (1+n)^alpha beta^n mod n^2
+    u3 = h1^alpha h2^gamma mod Ntilde
+    e  = H(G, Q, c, z, u1, u2, u3)
+    s1 = e*x + alpha;  s2 = r^e beta mod n;  s3 = e*rho + gamma
+
+  verifier: recompute e; accept iff
+    u1 == s1*G - e*Q
+    u2 == (1+n)^s1 s2^n c^{-e} mod n^2
+    u3 == h1^s1 h2^s3 z^{-e} mod Ntilde
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+
+from ..core import intops
+from ..core.paillier import EncryptionKey
+from ..core.secp256k1 import N as CURVE_ORDER
+from ..core.secp256k1 import Point, Scalar
+from ..core.transcript import Transcript
+from ..errors import PDLwSlackProofError
+
+__all__ = ["PDLwSlackStatement", "PDLwSlackWitness", "PDLwSlackProof", "commitment_unknown_order"]
+
+_DOMAIN = b"fsdkr/pdl-slack/v1"
+
+
+def commitment_unknown_order(h1: int, h2: int, modulus: int, x: int, r: int) -> int:
+    """h1^x * h2^r mod modulus over a group of unknown order; negative
+    exponents via modular inverse (reference
+    `/root/reference/src/zk_pdl_with_slack.rs:170-188`)."""
+    return (
+        intops.mod_pow_signed(h1, x, modulus)
+        * intops.mod_pow_signed(h2, r, modulus)
+        % modulus
+    )
+
+
+@dataclass(frozen=True)
+class PDLwSlackStatement:
+    # field set mirrors /root/reference/src/zk_pdl_with_slack.rs:24-32
+    ciphertext: int
+    ek: EncryptionKey
+    Q: Point
+    G: Point
+    h1: int
+    h2: int
+    N_tilde: int
+
+
+@dataclass(frozen=True)
+class PDLwSlackWitness:
+    x: Scalar
+    r: int
+
+
+@dataclass(frozen=True)
+class PDLwSlackProof:
+    z: int
+    u1: Point
+    u2: int
+    u3: int
+    s1: int
+    s2: int
+    s3: int
+
+    @staticmethod
+    def _challenge(st: PDLwSlackStatement, z: int, u1: Point, u2: int, u3: int) -> int:
+        # transcript fields mirror /root/reference/src/zk_pdl_with_slack.rs:87-95
+        return (
+            Transcript(_DOMAIN)
+            .chain_point(st.G)
+            .chain_point(st.Q)
+            .chain_int(st.ciphertext)
+            .chain_int(z)
+            .chain_point(u1)
+            .chain_int(u2)
+            .chain_int(u3)
+            .result_int()
+        )
+
+    @staticmethod
+    def prove(witness: PDLwSlackWitness, st: PDLwSlackStatement) -> "PDLwSlackProof":
+        q = CURVE_ORDER
+        q3 = q**3
+        alpha = secrets.randbelow(q3)
+        beta = 1 + secrets.randbelow(st.ek.n - 1)
+        rho = secrets.randbelow(q * st.N_tilde)
+        gamma = secrets.randbelow(q3 * st.N_tilde)
+
+        z = commitment_unknown_order(st.h1, st.h2, st.N_tilde, witness.x.to_int(), rho)
+        u1 = st.G * Scalar.from_int(alpha)
+        u2 = commitment_unknown_order(st.ek.n + 1, beta, st.ek.nn, alpha, st.ek.n)
+        u3 = commitment_unknown_order(st.h1, st.h2, st.N_tilde, alpha, gamma)
+
+        e = PDLwSlackProof._challenge(st, z, u1, u2, u3)
+
+        s1 = e * witness.x.to_int() + alpha
+        s2 = commitment_unknown_order(witness.r, beta, st.ek.n, e, 1)
+        s3 = e * rho + gamma
+        return PDLwSlackProof(z=z, u1=u1, u2=u2, u3=u3, s1=s1, s2=s2, s3=s3)
+
+    def verify(self, st: PDLwSlackStatement) -> None:
+        """Raises PDLwSlackProofError with per-equation booleans on failure
+        (reference `src/zk_pdl_with_slack.rs:158-166`)."""
+        e = PDLwSlackProof._challenge(st, self.z, self.u1, self.u2, self.u3)
+
+        g_s1 = st.G * Scalar.from_int(self.s1)
+        e_neg = Scalar.from_int(CURVE_ORDER - e % CURVE_ORDER)
+        u1_test = g_s1 + st.Q * e_neg
+
+        u2_test_tmp = commitment_unknown_order(
+            st.ek.n + 1, self.s2, st.ek.nn, self.s1, st.ek.n
+        )
+        u2_test = commitment_unknown_order(u2_test_tmp, st.ciphertext, st.ek.nn, 1, -e)
+
+        u3_test_tmp = commitment_unknown_order(
+            st.h1, st.h2, st.N_tilde, self.s1, self.s3
+        )
+        u3_test = commitment_unknown_order(u3_test_tmp, self.z, st.N_tilde, 1, -e)
+
+        ok1, ok2, ok3 = self.u1 == u1_test, self.u2 == u2_test, self.u3 == u3_test
+        if not (ok1 and ok2 and ok3):
+            raise PDLwSlackProofError(ok1, ok2, ok3)

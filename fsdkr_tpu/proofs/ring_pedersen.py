@@ -1,0 +1,108 @@
+"""Ring-Pedersen parameter proof: S = T^lambda mod N with T a square,
+proven by an M-round binary-challenge sigma protocol, Fiat-Shamir batched.
+
+Re-derivation of the reference's `RingPedersenProof`
+(`/root/reference/src/ring_pedersen_proof.rs`; from the UC non-interactive
+threshold-ECDSA paper). Challenge bits use the same Lsb0 digest-bit
+semantics (`src/ring_pedersen_proof.rs:106,136`).
+
+Conscious fix vs the reference (SURVEY.md §5 behavioral quirks): the
+reference serializes the secret `phi` inside the broadcast statement
+(`src/ring_pedersen_proof.rs:34` has no serde skip). Here `phi` lives in
+the witness only; the wire statement is (S, T, N, ek).
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+from typing import List
+
+from ..config import ProtocolConfig, DEFAULT_CONFIG
+from ..core import primes
+from ..core.paillier import EncryptionKey
+from ..core.transcript import Transcript, challenge_bits
+from ..errors import RingPedersenProofError
+
+__all__ = ["RingPedersenStatement", "RingPedersenWitness", "RingPedersenProof"]
+
+_DOMAIN = b"fsdkr/ring-pedersen/v1"
+
+
+@dataclass(frozen=True)
+class RingPedersenStatement:
+    S: int
+    T: int
+    N: int
+    ek: EncryptionKey
+
+    @staticmethod
+    def generate(
+        config: ProtocolConfig = DEFAULT_CONFIG,
+    ) -> tuple["RingPedersenStatement", "RingPedersenWitness"]:
+        """Fresh modulus; T = r^2 mod N, S = T^lambda mod N
+        (reference `src/ring_pedersen_proof.rs:48-74`)."""
+        n, p, q = primes.gen_modulus(config.paillier_bits)
+        phi = (p - 1) * (q - 1)
+        r = secrets.randbelow(n)
+        lam = secrets.randbelow(phi)
+        t = pow(r, 2, n)
+        s = pow(t, lam, n)
+        return (
+            RingPedersenStatement(S=s, T=t, N=n, ek=EncryptionKey.from_n(n)),
+            RingPedersenWitness(p=p, q=q, lam=lam, phi=phi),
+        )
+
+
+@dataclass(frozen=True)
+class RingPedersenWitness:
+    p: int
+    q: int
+    lam: int
+    phi: int
+
+
+@dataclass(frozen=True)
+class RingPedersenProof:
+    A: List[int]
+    Z: List[int]
+
+    @staticmethod
+    def _challenge(a_vec: List[int]) -> int:
+        t = Transcript(_DOMAIN)
+        for a_i in a_vec:
+            t.chain_int(a_i)
+        return t.result_int()
+
+    @staticmethod
+    def prove(
+        witness: RingPedersenWitness,
+        st: RingPedersenStatement,
+        m_security: int = DEFAULT_CONFIG.m_security,
+    ) -> "RingPedersenProof":
+        a_vec = [secrets.randbelow(witness.phi) for _ in range(m_security)]
+        A_vec = [pow(st.T, a_i, st.N) for a_i in a_vec]
+        e = RingPedersenProof._challenge(A_vec)
+        bits = challenge_bits(e, m_security)
+        Z_vec = [
+            (a_i + (witness.lam if b else 0)) % witness.phi
+            for a_i, b in zip(a_vec, bits)
+        ]
+        return RingPedersenProof(A=A_vec, Z=Z_vec)
+
+    def verify(
+        self,
+        st: RingPedersenStatement,
+        m_security: int = DEFAULT_CONFIG.m_security,
+    ) -> None:
+        """Per-bit check T^{Z_i} == A_i * S^{e_i} mod N
+        (reference `src/ring_pedersen_proof.rs:138-155`)."""
+        if len(self.A) != m_security or len(self.Z) != m_security:
+            raise RingPedersenProofError()
+        e = RingPedersenProof._challenge(self.A)
+        bits = challenge_bits(e, m_security)
+        for a_i, z_i, b in zip(self.A, self.Z, bits):
+            lhs = pow(st.T, z_i, st.N)
+            rhs = a_i * (st.S if b else 1) % st.N
+            if lhs != rhs:
+                raise RingPedersenProofError()

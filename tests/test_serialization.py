@@ -1,0 +1,56 @@
+"""Wire-format roundtrip tests: a refresh must succeed when every broadcast
+message crosses the canonical JSON wire (the reference's serde surface,
+SURVEY.md §2c), and LocalKey checkpoints must roundtrip."""
+
+from fsdkr_tpu.config import TEST_CONFIG
+from fsdkr_tpu.core import vss
+from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+from fsdkr_tpu.protocol.serialization import (
+    local_key_from_json,
+    local_key_to_json,
+    refresh_message_from_json,
+    refresh_message_to_json,
+)
+
+CFG = TEST_CONFIG
+
+
+def test_refresh_through_wire():
+    t, n = 1, 3
+    keys = simulate_keygen(t, n, CFG)
+    old_secret = vss.reconstruct(
+        vss.ShamirSecretSharing(t, n),
+        list(range(t + 1)),
+        [k.keys_linear.x_i for k in keys[: t + 1]],
+    )
+
+    wire_msgs, dks = [], []
+    for key in keys:
+        m, dk = RefreshMessage.distribute(key.i, key, n, CFG)
+        wire_msgs.append(refresh_message_to_json(m))  # serialize
+        dks.append(dk)
+
+    msgs = [refresh_message_from_json(w) for w in wire_msgs]  # deserialize
+    for key, dk in zip(keys, dks):
+        RefreshMessage.collect(msgs, key, dk, (), CFG)
+
+    new_secret = vss.reconstruct(
+        vss.ShamirSecretSharing(t, n),
+        list(range(t + 1)),
+        [k.keys_linear.x_i for k in keys[: t + 1]],
+    )
+    assert old_secret.v == new_secret.v
+
+
+def test_local_key_checkpoint_roundtrip():
+    keys = simulate_keygen(1, 3, CFG)
+    k = keys[0]
+    restored = local_key_from_json(local_key_to_json(k))
+    assert restored.i == k.i and restored.t == k.t and restored.n == k.n
+    assert restored.keys_linear.x_i.v == k.keys_linear.x_i.v
+    assert restored.paillier_dk.p == k.paillier_dk.p
+    assert restored.pk_vec == k.pk_vec
+    assert restored.y_sum_s == k.y_sum_s
+    assert [e.n for e in restored.paillier_key_vec] == [
+        e.n for e in k.paillier_key_vec
+    ]
