@@ -1,0 +1,20 @@
+"""TPU numeric layer (SURVEY.md §7 steps 1, 4-5).
+
+Big integers become fixed-shape limb tensors: 16-bit digits held in uint32
+lanes, so a single digit product (< 2^32) and long runs of lazy-carry
+accumulation both stay inside native TPU integer arithmetic. Everything is
+structure-of-arrays over a proof batch, and every batch is *multi-modulus*
+— each row carries its own modulus (each receiver has a different
+N / N^2 / N-tilde), which is the defining feature of the collect()
+workload (SURVEY.md §7 hard part 1).
+
+Modules:
+- limbs: int <-> limb-tensor conversion, Montgomery constants
+- montgomery: batched CIOS Montgomery multiplication + windowless modexp
+  (JAX/XLA; the Pallas kernel variant lives in pallas_montmul)
+- ec_batch: batched secp256k1 over 16-bit limb field elements
+"""
+
+from . import limbs, montgomery
+
+__all__ = ["limbs", "montgomery"]

@@ -1,0 +1,87 @@
+"""Limb-tensor representation of big integers.
+
+Base 2^16 digits in uint32 lanes: a b-bit integer is ceil(b/16) limbs,
+little-endian along the last axis. The choice of 16-bit digits makes a
+digit product fit uint32 exactly ((2^16-1)^2 < 2^32) and leaves ~2^15
+headroom for lazy-carry accumulation across a 2048/4096-bit CIOS pass
+(SURVEY.md §7 step 1).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+LIMB_BITS = 16
+LIMB_MASK = (1 << LIMB_BITS) - 1
+
+__all__ = [
+    "LIMB_BITS",
+    "LIMB_MASK",
+    "limbs_for_bits",
+    "ints_to_limbs",
+    "limbs_to_ints",
+    "MontgomeryContext",
+]
+
+
+def limbs_for_bits(bits: int) -> int:
+    return -(-bits // LIMB_BITS)
+
+
+def ints_to_limbs(xs: Sequence[int], num_limbs: int) -> np.ndarray:
+    """(B,) Python ints -> (B, num_limbs) uint32 little-endian base-2^16."""
+    out = np.zeros((len(xs), num_limbs), dtype=np.uint32)
+    for row, x in enumerate(xs):
+        if x < 0:
+            raise ValueError("limb encoding takes non-negative integers")
+        if x.bit_length() > num_limbs * LIMB_BITS:
+            raise ValueError(
+                f"integer of {x.bit_length()} bits exceeds {num_limbs} limbs"
+            )
+        j = 0
+        while x:
+            out[row, j] = x & LIMB_MASK
+            x >>= LIMB_BITS
+            j += 1
+    return out
+
+
+def limbs_to_ints(arr) -> List[int]:
+    """(B, K) limb array -> list of Python ints."""
+    a = np.asarray(arr, dtype=np.uint64)
+    out = []
+    for row in a:
+        x = 0
+        for j in range(len(row) - 1, -1, -1):
+            x = (x << LIMB_BITS) | int(row[j])
+        out.append(x)
+    return out
+
+
+class MontgomeryContext:
+    """Per-batch-row Montgomery constants for a multi-modulus batch.
+
+    For each (odd) modulus N_i with R = 2^(16*K):
+      n_prime_i = -N_i^{-1} mod 2^16   (digit-level CIOS constant)
+      r2_i      = R^2 mod N_i          (to-Montgomery conversion factor)
+      one_i     = R mod N_i            (Montgomery representation of 1)
+    """
+
+    def __init__(self, moduli: Sequence[int], num_limbs: int):
+        for n in moduli:
+            if n % 2 == 0 or n <= 1:
+                raise ValueError("Montgomery arithmetic requires odd moduli > 1")
+            if n.bit_length() > num_limbs * LIMB_BITS:
+                raise ValueError("modulus wider than limb layout")
+        self.num_limbs = num_limbs
+        self.moduli = list(moduli)
+        r = 1 << (LIMB_BITS * num_limbs)
+        self.n = ints_to_limbs(moduli, num_limbs)
+        self.n_prime = np.array(
+            [(-pow(n, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS) for n in moduli],
+            dtype=np.uint32,
+        )
+        self.r2 = ints_to_limbs([r * r % n for n in moduli], num_limbs)
+        self.one_mont = ints_to_limbs([r % n for n in moduli], num_limbs)

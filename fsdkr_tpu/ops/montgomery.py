@@ -1,0 +1,202 @@
+"""Batched multi-modulus Montgomery arithmetic in JAX (SURVEY.md §7 step 1,
+hard part 1).
+
+The workhorse of the TPU rebuild: the reference's O(n^2) serial
+`BigInt::mod_pow` calls (e.g. `/root/reference/src/range_proofs.rs:129-148`,
+`src/ring_pedersen_proof.rs:144`) become one batched modexp launch per
+proof-family equation. Each batch row carries its own modulus.
+
+Algorithm: CIOS (coarsely integrated operand scanning) over base-2^16
+digits in uint32 lanes, with lazy carries — per outer step each
+accumulator limb gains at most 4*(2^16-1) < 2^18, so across K <= 256 steps
+values stay < 2^26 << 2^32 and no per-step normalization is needed. The
+digit-product trick (lo/hi 16-bit split) keeps everything in native 32-bit
+TPU integer ops; there is no data-dependent control flow anywhere
+(exponent bits select between squared and multiplied values branchlessly),
+so the whole modexp jits to a single XLA loop nest and vmaps/shards
+cleanly.
+
+Exponentiation is plain MSB-first square-and-multiply with per-row
+exponent bits: 2 Montgomery multiplications per exponent bit, constant
+shape. (Windowed exponentiation is a later optimization; it changes only
+this file.)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .limbs import LIMB_BITS, LIMB_MASK, MontgomeryContext, ints_to_limbs, limbs_to_ints
+
+__all__ = [
+    "mont_mul_limbs",
+    "batch_modexp",
+    "batch_modmul",
+    "BatchModExp",
+]
+
+_U32 = jnp.uint32
+
+
+def _normalize_carries(t):
+    """Fully propagate pending carries: limbs -> canonical base-2^16.
+    Runs until fixpoint (data-dependent trip count, but each pass is a
+    fixed-shape vector op; 3-4 passes in practice)."""
+
+    def cond(t):
+        return jnp.any(t >> LIMB_BITS)
+
+    def body(t):
+        lo = t & LIMB_MASK
+        hi = t >> LIMB_BITS
+        hi_shift = jnp.concatenate(
+            [jnp.zeros_like(hi[:, :1]), hi[:, :-1]], axis=1
+        )
+        return lo + hi_shift
+
+    return lax.while_loop(cond, body, t)
+
+
+def _cond_subtract(t, n):
+    """Return t - n if t >= n else t, limbwise with a borrow scan.
+    t: (B, K+1) canonical limbs (value < 2n); n: (B, K)."""
+    b, k = n.shape
+    n_pad = jnp.concatenate([n, jnp.zeros((b, 1), _U32)], axis=1)
+
+    def step(borrow, limbs):
+        t_j, n_j = limbs
+        d = t_j + (jnp.uint32(1) << LIMB_BITS) - n_j - borrow
+        new_borrow = jnp.uint32(1) - (d >> LIMB_BITS)
+        return new_borrow, d & LIMB_MASK
+
+    borrow, diff_t = lax.scan(
+        step, jnp.zeros((b,), _U32), (t.T, n_pad.T)
+    )
+    diff = diff_t.T
+    keep = (borrow != 0)[:, None]  # borrow => t < n => keep t
+    return jnp.where(keep, t, diff)[:, :k]
+
+
+def mont_mul_limbs(x, y, n, n_prime):
+    """Batched Montgomery product x*y*R^{-1} mod n.
+
+    x, y, n: (B, K) canonical base-2^16 limbs, x,y < n; n_prime: (B,).
+    Returns canonical (B, K) limbs < n.
+    """
+    b, k = x.shape
+    t = jnp.zeros((b, k + 2), _U32)
+
+    def step(i, t):
+        x_i = lax.dynamic_index_in_dim(x, i, axis=1, keepdims=False)  # (B,)
+        p = x_i[:, None] * y  # digit products fit uint32 exactly
+        p_lo = p & LIMB_MASK
+        p_hi = p >> LIMB_BITS
+        m = ((t[:, 0] + p_lo[:, 0]) * n_prime) & LIMB_MASK
+        pm = m[:, None] * n
+        pm_lo = pm & LIMB_MASK
+        pm_hi = pm >> LIMB_BITS
+        t = t.at[:, :k].add(p_lo + pm_lo)
+        t = t.at[:, 1 : k + 1].add(p_hi + pm_hi)
+        # low limb is now 0 mod 2^16: divide by 2^16 (shift one limb down)
+        carry0 = t[:, 0] >> LIMB_BITS
+        t = jnp.concatenate([t[:, 1:], jnp.zeros((b, 1), _U32)], axis=1)
+        t = t.at[:, 0].add(carry0)
+        return t
+
+    t = lax.fori_loop(0, k, step, t)
+    t = _normalize_carries(t)
+    return _cond_subtract(t[:, : k + 1], n)
+
+
+@partial(jax.jit, static_argnames=("exp_bits",))
+def _modexp_kernel(base, exp, n, n_prime, r2, one_mont, *, exp_bits):
+    """result = base^exp mod n, per row. exp: (B, EL) limbs."""
+    base_m = mont_mul_limbs(base, r2, n, n_prime)  # to Montgomery domain
+    acc = one_mont
+
+    def step(i, acc):
+        bit_index = exp_bits - 1 - i
+        limb = lax.dynamic_index_in_dim(
+            exp, bit_index // LIMB_BITS, axis=1, keepdims=False
+        )
+        bit = (limb >> (bit_index % LIMB_BITS)) & 1  # (B,)
+        acc = mont_mul_limbs(acc, acc, n, n_prime)
+        mult = mont_mul_limbs(acc, base_m, n, n_prime)
+        return jnp.where((bit == 1)[:, None], mult, acc)
+
+    acc = lax.fori_loop(0, exp_bits, step, acc)
+    # leave Montgomery domain: multiply by 1
+    one = jnp.zeros_like(acc).at[:, 0].set(1)
+    return mont_mul_limbs(acc, one, n, n_prime)
+
+
+@jax.jit
+def _modmul_kernel(a, b, n, n_prime, r2):
+    """a*b mod n per row (via a*R * b * R^{-1})."""
+    a_m = mont_mul_limbs(a, r2, n, n_prime)
+    return mont_mul_limbs(a_m, b, n, n_prime)
+
+
+class BatchModExp:
+    """Reusable multi-modulus batch context: fix the moduli once (they are
+    per-party constants of a refresh), then run modexp/modmul batches.
+
+    Device placement follows JAX defaults (the single real TPU chip under
+    the bench, virtual CPU devices under tests); sharded execution across a
+    mesh is layered on in fsdkr_tpu.parallel.
+    """
+
+    def __init__(self, moduli: Sequence[int], num_limbs: int):
+        self.ctx = MontgomeryContext(moduli, num_limbs)
+        self._n = jnp.asarray(self.ctx.n)
+        self._n_prime = jnp.asarray(self.ctx.n_prime)
+        self._r2 = jnp.asarray(self.ctx.r2)
+        self._one_mont = jnp.asarray(self.ctx.one_mont)
+
+    def modexp(self, bases: Sequence[int], exps: Sequence[int]) -> List[int]:
+        k = self.ctx.num_limbs
+        bases = [b % n for b, n in zip(bases, self.ctx.moduli)]
+        exp_bits = max((e.bit_length() for e in exps), default=1) or 1
+        exp_limbs = ints_to_limbs(exps, -(-exp_bits // LIMB_BITS))
+        out = _modexp_kernel(
+            jnp.asarray(ints_to_limbs(bases, k)),
+            jnp.asarray(exp_limbs),
+            self._n,
+            self._n_prime,
+            self._r2,
+            self._one_mont,
+            exp_bits=exp_bits,
+        )
+        return limbs_to_ints(np.asarray(out))
+
+    def modmul(self, a: Sequence[int], b: Sequence[int]) -> List[int]:
+        k = self.ctx.num_limbs
+        a = [x % n for x, n in zip(a, self.ctx.moduli)]
+        b = [x % n for x, n in zip(b, self.ctx.moduli)]
+        out = _modmul_kernel(
+            jnp.asarray(ints_to_limbs(a, k)),
+            jnp.asarray(ints_to_limbs(b, k)),
+            self._n,
+            self._n_prime,
+            self._r2,
+        )
+        return limbs_to_ints(np.asarray(out))
+
+
+def batch_modexp(
+    bases: Sequence[int], exps: Sequence[int], moduli: Sequence[int], num_limbs: int
+) -> List[int]:
+    """One-shot convenience wrapper: bases^exps mod moduli, row-wise."""
+    return BatchModExp(moduli, num_limbs).modexp(bases, exps)
+
+
+def batch_modmul(
+    a: Sequence[int], b: Sequence[int], moduli: Sequence[int], num_limbs: int
+) -> List[int]:
+    return BatchModExp(moduli, num_limbs).modmul(a, b)

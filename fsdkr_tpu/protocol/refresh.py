@@ -22,8 +22,7 @@ conscious fix, pinned by tests):
 
 from __future__ import annotations
 
-import secrets
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..backend import get_backend
@@ -32,7 +31,6 @@ from ..core import paillier, vss
 from ..core.paillier import DecryptionKey, EncryptionKey
 from ..core.secp256k1 import GENERATOR, Point, Scalar
 from ..errors import (
-    FsDkrError,
     ModuliTooSmall,
     NewPartyUnassignedIndexError,
     PaillierVerificationError,
@@ -183,7 +181,7 @@ class RefreshMessage:
                 len(msg.points_committed_vec),
                 len(msg.points_encrypted_vec),
             )
-            if any(l != n for l in lens):
+            if any(l != n for l in lens) or len(msg.range_proofs) != n:
                 raise SizeMismatchError(k, *lens)
 
         backend = get_backend(config)
@@ -414,6 +412,3 @@ def combine_committed_points(
     return pk_vec
 
 
-# imported at the bottom to avoid a cycle: join.py needs RefreshMessage's
-# validate_collect / get_ciphertext_sum
-from .join import JoinMessage  # noqa: E402

@@ -1,0 +1,108 @@
+"""Differential tests: TPU limb kernels vs the CPython host oracle
+(SURVEY.md §4 rebuild implication v — every kernel checked against the
+Python-int oracle). Runs on the virtual CPU platform (see conftest)."""
+
+import secrets
+
+import numpy as np
+import pytest
+
+from fsdkr_tpu.core import primes
+from fsdkr_tpu.ops import limbs
+from fsdkr_tpu.ops.montgomery import BatchModExp, batch_modexp, batch_modmul
+
+
+class TestLimbs:
+    def test_roundtrip(self):
+        xs = [0, 1, (1 << 512) - 1, secrets.randbits(500)]
+        arr = limbs.ints_to_limbs(xs, limbs.limbs_for_bits(512))
+        assert limbs.limbs_to_ints(arr) == xs
+
+    def test_rejects_overflow(self):
+        with pytest.raises(ValueError):
+            limbs.ints_to_limbs([1 << 64], 4)
+
+    def test_rejects_negative(self):
+        with pytest.raises(ValueError):
+            limbs.ints_to_limbs([-1], 4)
+
+    def test_montgomery_context_rejects_even(self):
+        with pytest.raises(ValueError):
+            limbs.MontgomeryContext([6], 4)
+
+
+def _random_moduli(bits, count):
+    """Odd moduli of roughly `bits` bits, mixed shapes (prime products and
+    arbitrary odd numbers — Montgomery needs only oddness)."""
+    out = []
+    for i in range(count):
+        if i % 2:
+            out.append(secrets.randbits(bits) | (1 << (bits - 1)) | 1)
+        else:
+            half = bits // 2
+            out.append(primes.gen_prime(half) * primes.gen_prime(half))
+    return out
+
+
+class TestBatchModExp:
+    @pytest.mark.parametrize("bits", [256, 768, 1536])
+    def test_vs_host_oracle(self, bits):
+        B = 8
+        moduli = _random_moduli(bits, B)
+        bases = [secrets.randbelow(n) for n in moduli]
+        exps = [secrets.randbits(bits) for _ in range(B)]
+        k = limbs.limbs_for_bits(bits)
+        got = batch_modexp(bases, exps, moduli, k)
+        want = [pow(b, e, n) for b, e, n in zip(bases, exps, moduli)]
+        assert got == want
+
+    def test_mixed_exponent_sizes(self):
+        bits = 512
+        B = 6
+        moduli = _random_moduli(bits, B)
+        bases = [secrets.randbelow(n) for n in moduli]
+        exps = [0, 1, 2, secrets.randbits(17), secrets.randbits(256), secrets.randbits(512)]
+        got = batch_modexp(bases, exps, moduli, limbs.limbs_for_bits(bits))
+        assert got == [pow(b, e, n) for b, e, n in zip(bases, exps, moduli)]
+
+    def test_base_reduction(self):
+        # bases >= modulus are reduced on the host side before the kernel
+        bits = 256
+        moduli = _random_moduli(bits, 2)
+        bases = [moduli[0] + 5, moduli[1] * 2 + 7]
+        exps = [3, 5]
+        got = batch_modexp(bases, exps, moduli, limbs.limbs_for_bits(bits))
+        assert got == [pow(b, e, n) for b, e, n in zip(bases, exps, moduli)]
+
+    def test_modmul(self):
+        bits = 768
+        B = 8
+        moduli = _random_moduli(bits, B)
+        a = [secrets.randbelow(n) for n in moduli]
+        b = [secrets.randbelow(n) for n in moduli]
+        got = batch_modmul(a, b, moduli, limbs.limbs_for_bits(bits))
+        assert got == [(x * y) % n for x, y, n in zip(a, b, moduli)]
+
+    def test_reusable_context(self):
+        bits = 512
+        moduli = _random_moduli(bits, 4)
+        ctx = BatchModExp(moduli, limbs.limbs_for_bits(bits))
+        for _ in range(3):
+            bases = [secrets.randbelow(n) for n in moduli]
+            exps = [secrets.randbits(200) for _ in moduli]
+            assert ctx.modexp(bases, exps) == [
+                pow(b, e, n) for b, e, n in zip(bases, exps, moduli)
+            ]
+
+    def test_worst_case_carry_chains(self):
+        # moduli / operands built from long 0xffff runs stress the lazy
+        # carry normalization and the borrow scan
+        bits = 512
+        k = limbs.limbs_for_bits(bits)
+        n1 = (1 << bits) - 1  # all-ones odd modulus
+        n2 = (1 << bits) - (1 << 17) + 1
+        moduli = [n1, n2]
+        bases = [n1 - 1, n2 - 2]
+        exps = [n1 - 1, (1 << 256) + 1]
+        got = batch_modexp(bases, exps, moduli, k)
+        assert got == [pow(b, e, n) for b, e, n in zip(bases, exps, moduli)]
