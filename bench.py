@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""North-star benchmark (BASELINE.json): RefreshMessage.collect wall-clock,
+reported as proofs verified per second, TPU batch backend vs the host
+(pure-Python) baseline on the identical workload.
+
+Prints exactly ONE JSON line to stdout:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+All progress goes to stderr.
+
+Default workload: a real full-size refresh (2048-bit Paillier, M=256
+ring-Pedersen, 11 correct-key rounds) at committee n=16, t=8 — one
+collecting party verifies 2*n^2 PDL+range proofs, n ring-Pedersen and n
+correct-key proofs (plus n^2 Feldman EC checks). `vs_baseline` is the
+speedup of the TPU backend over the host backend (host measured on a
+subsample, extrapolated linearly — it is a serial per-proof loop).
+
+Environment knobs: BENCH_N / BENCH_T / BENCH_BITS / BENCH_M override the
+workload for experiments; defaults match BASELINE.md.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    n = int(os.environ.get("BENCH_N", "16"))
+    t = int(os.environ.get("BENCH_T", "8"))
+    bits = int(os.environ.get("BENCH_BITS", "2048"))
+    m_sec = int(os.environ.get("BENCH_M", "256"))
+
+    # persistent compilation cache: repeat bench runs skip XLA compiles
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    except Exception:
+        pass
+
+    from fsdkr_tpu.config import ProtocolConfig
+    from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+
+    cfg = ProtocolConfig(paillier_bits=bits, m_security=m_sec)
+    tpu_cfg = cfg.with_backend("tpu")
+
+    log(f"devices: {jax.devices()}")
+    log(f"setup: keygen + distribute, n={n} t={t} bits={bits} M={m_sec} ...")
+    t0 = time.time()
+    keys = simulate_keygen(t, n, cfg)
+    t_keygen = time.time() - t0
+
+    t0 = time.time()
+    msgs, dks = [], []
+    for key in keys:
+        m, dk = RefreshMessage.distribute(key.i, key, n, cfg)
+        msgs.append(m)
+        dks.append(dk)
+    t_distribute = time.time() - t0
+    log(f"setup done: keygen {t_keygen:.1f}s, distribute {t_distribute:.1f}s")
+
+    # proof instances verified by one collect (excluding n^2 Feldman EC
+    # checks and 2 joins' dlog proofs, which are zero here)
+    proofs = 2 * n * n + 2 * n
+
+    # --- TPU backend: warm-up (compiles), then timed run ----------------
+    log("tpu collect: warm-up (compiles cached to .jax_cache) ...")
+    t0 = time.time()
+    RefreshMessage.collect(msgs, keys[0].clone(), dks[0], (), tpu_cfg)
+    t_tpu_cold = time.time() - t0
+    log(f"tpu collect cold: {t_tpu_cold:.2f}s")
+
+    t0 = time.time()
+    RefreshMessage.collect(msgs, keys[1].clone(), dks[1], (), tpu_cfg)
+    t_tpu = time.time() - t0
+    log(f"tpu collect warm: {t_tpu:.2f}s -> {proofs / t_tpu:.1f} proofs/s")
+
+    # --- host baseline on a subsample (serial loop; linear extrapolation)
+    from fsdkr_tpu.backend.batch_verifier import HostBatchVerifier
+    from fsdkr_tpu.core.secp256k1 import GENERATOR
+    from fsdkr_tpu.proofs.pdl_slack import PDLwSlackStatement
+
+    host = HostBatchVerifier()
+    key = keys[2]
+    sample = max(4, n // 2)
+    pdl_items, range_items = [], []
+    for msg in msgs[:2]:
+        for i in range(sample // 2):
+            st = PDLwSlackStatement(
+                ciphertext=msg.points_encrypted_vec[i],
+                ek=key.paillier_key_vec[i],
+                Q=msg.points_committed_vec[i],
+                G=GENERATOR,
+                h1=key.h1_h2_n_tilde_vec[i].g,
+                h2=key.h1_h2_n_tilde_vec[i].ni,
+                N_tilde=key.h1_h2_n_tilde_vec[i].N,
+            )
+            pdl_items.append((msg.pdl_proof_vec[i], st))
+            range_items.append(
+                (
+                    msg.range_proofs[i],
+                    msg.points_encrypted_vec[i],
+                    key.paillier_key_vec[i],
+                    key.h1_h2_n_tilde_vec[i],
+                )
+            )
+
+    t0 = time.time()
+    assert all(v is None for v in host.verify_pdl(pdl_items))
+    assert all(host.verify_range(range_items))
+    per_pair = (time.time() - t0) / len(pdl_items)
+
+    rp_items = [(m.ring_pedersen_proof, m.ring_pedersen_statement) for m in msgs[:2]]
+    t0 = time.time()
+    assert all(host.verify_ring_pedersen(rp_items, m_sec))
+    per_rp = (time.time() - t0) / len(rp_items)
+
+    ck_items = [(m.dk_correctness_proof, m.ek) for m in msgs[:2]]
+    t0 = time.time()
+    assert all(host.verify_correct_key(ck_items, cfg.correct_key_rounds))
+    per_ck = (time.time() - t0) / len(ck_items)
+
+    t_host = n * n * per_pair + n * per_rp + n * per_ck
+    log(
+        f"host baseline (extrapolated from {len(pdl_items)} pairs): "
+        f"{t_host:.2f}s -> {proofs / t_host:.1f} proofs/s"
+    )
+
+    result = {
+        "metric": f"collect() proof verification throughput @ n={n},t={t},{bits}-bit",
+        "value": round(proofs / t_tpu, 2),
+        "unit": "proofs/s",
+        "vs_baseline": round(t_host / t_tpu, 2),
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

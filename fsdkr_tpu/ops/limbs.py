@@ -31,33 +31,40 @@ def limbs_for_bits(bits: int) -> int:
 
 
 def ints_to_limbs(xs: Sequence[int], num_limbs: int) -> np.ndarray:
-    """(B,) Python ints -> (B, num_limbs) uint32 little-endian base-2^16."""
-    out = np.zeros((len(xs), num_limbs), dtype=np.uint32)
+    """(B,) Python ints -> (B, num_limbs) uint32 little-endian base-2^16.
+
+    Via to_bytes + frombuffer: CPython serializes in C, so the host-side
+    conversion cost is O(bytes) rather than a Python-level shift loop.
+    """
+    nbytes = num_limbs * (LIMB_BITS // 8)
+    buf = bytearray(len(xs) * nbytes)
     for row, x in enumerate(xs):
         if x < 0:
             raise ValueError("limb encoding takes non-negative integers")
-        if x.bit_length() > num_limbs * LIMB_BITS:
+        try:
+            buf[row * nbytes : (row + 1) * nbytes] = x.to_bytes(nbytes, "little")
+        except OverflowError:
             raise ValueError(
                 f"integer of {x.bit_length()} bits exceeds {num_limbs} limbs"
-            )
-        j = 0
-        while x:
-            out[row, j] = x & LIMB_MASK
-            x >>= LIMB_BITS
-            j += 1
-    return out
+            ) from None
+    return np.frombuffer(bytes(buf), dtype="<u2").reshape(
+        len(xs), num_limbs
+    ).astype(np.uint32)
 
 
 def limbs_to_ints(arr) -> List[int]:
     """(B, K) limb array -> list of Python ints."""
-    a = np.asarray(arr, dtype=np.uint64)
-    out = []
-    for row in a:
-        x = 0
-        for j in range(len(row) - 1, -1, -1):
-            x = (x << LIMB_BITS) | int(row[j])
-        out.append(x)
-    return out
+    a = np.asarray(arr)
+    if a.ndim != 2:
+        raise ValueError("expected a (B, K) limb array")
+    if (a >> LIMB_BITS).any():
+        raise ValueError("limb array not canonical (pending carries)")
+    raw = a.astype("<u2").tobytes()
+    nbytes = a.shape[1] * (LIMB_BITS // 8)
+    return [
+        int.from_bytes(raw[i * nbytes : (i + 1) * nbytes], "little")
+        for i in range(a.shape[0])
+    ]
 
 
 class MontgomeryContext:

@@ -16,10 +16,12 @@ TPU integer ops; there is no data-dependent control flow anywhere
 so the whole modexp jits to a single XLA loop nest and vmaps/shards
 cleanly.
 
-Exponentiation is plain MSB-first square-and-multiply with per-row
-exponent bits: 2 Montgomery multiplications per exponent bit, constant
-shape. (Windowed exponentiation is a later optimization; it changes only
-this file.)
+Exponentiation is MSB-first fixed-window (4-bit): per window, 4
+Montgomery squarings and one branchless 16-entry table multiply —
+~1.27 Montgomery multiplications per exponent bit, constant shape.
+Exponent widths are bucketed to powers of two >= 64 (see
+`bucket_exp_bits`), which also caps the number of compiled kernel
+variants.
 """
 
 from __future__ import annotations
@@ -38,8 +40,17 @@ __all__ = [
     "mont_mul_limbs",
     "batch_modexp",
     "batch_modmul",
+    "bucket_exp_bits",
     "BatchModExp",
 ]
+
+
+def bucket_exp_bits(exps) -> int:
+    """Exponent width for a batch: the max bit length rounded up to a
+    power of two >= 64. Guarantees the multiple-of-4 width the windowed
+    kernel requires and caps compiled variants per (B, K) at ~8."""
+    bits = max((e.bit_length() for e in exps), default=1) or 1
+    return max(64, 1 << (bits - 1).bit_length())
 
 _U32 = jnp.uint32
 
@@ -114,23 +125,50 @@ def mont_mul_limbs(x, y, n, n_prime):
     return _cond_subtract(t[:, : k + 1], n)
 
 
+_WINDOW = 4  # 4-bit fixed windows: 4 squarings + 1 table multiply per window
+
+
 @partial(jax.jit, static_argnames=("exp_bits",))
 def _modexp_kernel(base, exp, n, n_prime, r2, one_mont, *, exp_bits):
-    """result = base^exp mod n, per row. exp: (B, EL) limbs."""
+    """result = base^exp mod n, per row. exp: (B, EL) limbs.
+
+    Fixed-window exponentiation, MSB-first: per 4-bit window, 4 Montgomery
+    squarings and one branchless table multiply (the w=0 entry is the
+    Montgomery one, so every window costs the same — no data-dependent
+    control flow). exp_bits must be a multiple of 4 (the bucketing in
+    BatchModExp guarantees powers of two >= 64), so a window never
+    straddles a 16-bit exponent limb.
+    """
+    assert exp_bits % _WINDOW == 0
     base_m = mont_mul_limbs(base, r2, n, n_prime)  # to Montgomery domain
-    acc = one_mont
 
-    def step(i, acc):
-        bit_index = exp_bits - 1 - i
+    # table[j] = base_m^j (Montgomery domain), j = 0..15
+    def build(j, table):
+        prev = table[j - 1]
+        table = table.at[j].set(mont_mul_limbs(prev, base_m, n, n_prime))
+        return table
+
+    table0 = jnp.zeros((1 << _WINDOW,) + base.shape, _U32)
+    table0 = table0.at[0].set(one_mont).at[1].set(base_m)
+    table = lax.fori_loop(2, 1 << _WINDOW, build, table0)
+
+    idx = jnp.arange(1 << _WINDOW, dtype=_U32)[:, None, None]
+
+    def step(wi, acc):
+        shift = exp_bits - _WINDOW * (wi + 1)
         limb = lax.dynamic_index_in_dim(
-            exp, bit_index // LIMB_BITS, axis=1, keepdims=False
+            exp, shift // LIMB_BITS, axis=1, keepdims=False
         )
-        bit = (limb >> (bit_index % LIMB_BITS)) & 1  # (B,)
-        acc = mont_mul_limbs(acc, acc, n, n_prime)
-        mult = mont_mul_limbs(acc, base_m, n, n_prime)
-        return jnp.where((bit == 1)[:, None], mult, acc)
+        w = (limb >> (shift % LIMB_BITS)) & ((1 << _WINDOW) - 1)  # (B,)
+        for _ in range(_WINDOW):
+            acc = mont_mul_limbs(acc, acc, n, n_prime)
+        # branchless table select: sum over one-hot window match
+        sel = jnp.sum(
+            jnp.where(w[None, :, None] == idx, table, jnp.uint32(0)), axis=0
+        )
+        return mont_mul_limbs(acc, sel, n, n_prime)
 
-    acc = lax.fori_loop(0, exp_bits, step, acc)
+    acc = lax.fori_loop(0, exp_bits // _WINDOW, step, one_mont)
     # leave Montgomery domain: multiply by 1
     one = jnp.zeros_like(acc).at[:, 0].set(1)
     return mont_mul_limbs(acc, one, n, n_prime)
@@ -162,7 +200,7 @@ class BatchModExp:
     def modexp(self, bases: Sequence[int], exps: Sequence[int]) -> List[int]:
         k = self.ctx.num_limbs
         bases = [b % n for b, n in zip(bases, self.ctx.moduli)]
-        exp_bits = max((e.bit_length() for e in exps), default=1) or 1
+        exp_bits = bucket_exp_bits(exps)
         exp_limbs = ints_to_limbs(exps, -(-exp_bits // LIMB_BITS))
         out = _modexp_kernel(
             jnp.asarray(ints_to_limbs(bases, k)),

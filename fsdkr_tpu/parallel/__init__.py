@@ -1,0 +1,15 @@
+"""Device-mesh parallelism (SURVEY.md §5 "distributed communication",
+§7 step 9).
+
+The verification workload is embarrassingly parallel over proof rows:
+sharding is a 1-D mesh over the batch axis, each device verifies its row
+slice, and the only cross-device communication algorithmically required is
+the reduction of verdict bits (a psum over the mesh, riding ICI). Sessions
+(independent refreshes) stack onto the same batch axis — multi-session
+scale-out is a reshape, not a new mechanism.
+"""
+
+from .mesh import make_mesh
+from .sharded_verify import sharded_modexp, sharded_verdict_step
+
+__all__ = ["make_mesh", "sharded_modexp", "sharded_verdict_step"]

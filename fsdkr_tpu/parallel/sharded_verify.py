@@ -1,0 +1,160 @@
+"""Sharded batch verification over a device mesh.
+
+Two entry points:
+
+- `sharded_modexp`: the multi-modulus modexp batch with rows sharded over
+  the mesh's "batch" axis via shard_map; each device runs the same CIOS
+  loop on its row slice. Returns the full result (XLA all-gathers on
+  output resolution).
+
+- `sharded_verdict_step`: the "training step" shape of this framework —
+  one fused, jitted step that takes an equation batch
+  (lhs_base^lhs_exp ?= rhs mod N, rows sharded), verifies every row on its
+  owning device, and psums the per-device failure counts across the mesh,
+  so the only cross-device traffic is verdict bits (SURVEY.md §5:
+  "no cross-chip communication is algorithmically required ... only an
+  all-gather of verdict bits").
+
+Sessions are a leading reshape: 64 independent n=16 refreshes stack their
+rows on the same batch axis (BASELINE.json config 5).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.limbs import LIMB_BITS, MontgomeryContext, ints_to_limbs, limbs_to_ints
+from ..ops.montgomery import _modexp_kernel, bucket_exp_bits
+
+__all__ = ["sharded_modexp", "sharded_verdict_step", "pad_rows"]
+
+
+def pad_rows(n_rows: int, n_devices: int) -> int:
+    """Rows must split evenly across devices; pad with dummy rows."""
+    return -(-n_rows // n_devices) * n_devices
+
+
+def sharded_modexp(
+    bases: Sequence[int],
+    exps: Sequence[int],
+    moduli: Sequence[int],
+    num_limbs: int,
+    mesh: jax.sharding.Mesh,
+) -> List[int]:
+    """bases^exps mod moduli row-wise, rows sharded over mesh axis "batch".
+
+    Dummy padding rows (modulus 3, base 1, exp 0) make the row count divide
+    the mesh; they are stripped before returning.
+    """
+    n_dev = mesh.devices.size
+    b = len(bases)
+    b_pad = pad_rows(b, n_dev)
+    bases = list(bases) + [1] * (b_pad - b)
+    exps = list(exps) + [0] * (b_pad - b)
+    moduli = list(moduli) + [3] * (b_pad - b)
+
+    ctx = MontgomeryContext(moduli, num_limbs)
+    exp_bits = bucket_exp_bits(exps)
+    exp_limbs = ints_to_limbs(exps, -(-exp_bits // LIMB_BITS))
+    base_limbs = ints_to_limbs(
+        [x % n for x, n in zip(bases, moduli)], num_limbs
+    )
+
+    row = tuple(mesh.axis_names)  # rows shard over every mesh axis
+    kernel = partial(_modexp_kernel.__wrapped__, exp_bits=exp_bits)
+    sharded = jax.shard_map(
+        kernel,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(
+            P(row, None),  # base
+            P(row, None),  # exp
+            P(row, None),  # n
+            P(row),  # n_prime
+            P(row, None),  # r2
+            P(row, None),  # one_mont
+        ),
+        out_specs=P(row, None),
+    )
+    out = jax.jit(sharded)(
+        jnp.asarray(base_limbs),
+        jnp.asarray(exp_limbs),
+        jnp.asarray(ctx.n),
+        jnp.asarray(ctx.n_prime),
+        jnp.asarray(ctx.r2),
+        jnp.asarray(ctx.one_mont),
+    )
+    return limbs_to_ints(np.asarray(out))[:b]
+
+
+def sharded_verdict_step(
+    bases: Sequence[int],
+    exps: Sequence[int],
+    moduli: Sequence[int],
+    expected: Sequence[int],
+    num_limbs: int,
+    mesh: jax.sharding.Mesh,
+) -> tuple[np.ndarray, int]:
+    """One fused verification step: row-sharded modexp, per-row comparison
+    against `expected`, and a psum of failure counts over the mesh.
+
+    Returns (per-row ok bits, global failure count). The failure count is
+    computed with an explicit cross-device collective — the protocol's
+    only required communication.
+    """
+    n_dev = mesh.devices.size
+    b = len(bases)
+    b_pad = pad_rows(b, n_dev)
+    pad = b_pad - b
+    bases = list(bases) + [1] * pad
+    exps = list(exps) + [0] * pad
+    moduli = list(moduli) + [3] * pad
+    expected = list(expected) + [1] * pad
+
+    ctx = MontgomeryContext(moduli, num_limbs)
+    exp_bits = bucket_exp_bits(exps)
+    exp_limbs = ints_to_limbs(exps, -(-exp_bits // LIMB_BITS))
+    base_limbs = ints_to_limbs([x % n for x, n in zip(bases, moduli)], num_limbs)
+    want_limbs = ints_to_limbs([x % n for x, n in zip(expected, moduli)], num_limbs)
+
+    row = tuple(mesh.axis_names)  # rows shard over every mesh axis
+
+    def step(base, exp, n, n_prime, r2, one_mont, want):
+        got = _modexp_kernel.__wrapped__(
+            base, exp, n, n_prime, r2, one_mont, exp_bits=exp_bits
+        )
+        ok = jnp.all(got == want, axis=1)
+        failures = jax.lax.psum(jnp.sum(~ok), row)
+        return ok, failures
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        check_vma=False,
+        in_specs=(
+            P(row, None),
+            P(row, None),
+            P(row, None),
+            P(row),
+            P(row, None),
+            P(row, None),
+            P(row, None),
+        ),
+        out_specs=(P(row), P()),
+    )
+    ok, failures = jax.jit(sharded)(
+        jnp.asarray(base_limbs),
+        jnp.asarray(exp_limbs),
+        jnp.asarray(ctx.n),
+        jnp.asarray(ctx.n_prime),
+        jnp.asarray(ctx.r2),
+        jnp.asarray(ctx.one_mont),
+        jnp.asarray(want_limbs),
+    )
+    return np.asarray(ok)[:b], int(failures)

@@ -1,20 +1,26 @@
 """Test configuration.
 
-Multi-chip sharding is tested on a virtual 8-device CPU mesh: JAX is forced
-onto the CPU platform with 8 host devices before any test imports JAX, so
-`jax.sharding.Mesh`/`shard_map` paths compile and execute without TPU
-hardware. The single real TPU chip is exercised by bench.py, not the unit
-suite.
+Multi-chip sharding is tested on a virtual 8-device CPU mesh. NOTE: in
+this environment the axon TPU plugin ignores JAX_PLATFORMS / XLA_FLAGS
+environment variables, so the platform must be forced through jax.config
+*before* the backend initializes — which is why this happens here, ahead
+of any test importing jax.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # belt (honored by stock jax)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# braces (required with the axon plugin installed)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,168 @@
+"""Differential tests host-vs-TPU backend: same verdicts on valid and
+tampered proofs, and a full collect() running end-to-end on the batched
+backend (on the virtual CPU platform; bench.py exercises the real chip)."""
+
+import copy
+import dataclasses
+
+import pytest
+
+from fsdkr_tpu.backend.batch_verifier import HostBatchVerifier
+from fsdkr_tpu.backend.tpu_verifier import TpuBatchVerifier
+from fsdkr_tpu.config import TEST_CONFIG
+from fsdkr_tpu.core import vss
+from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+
+CFG = TEST_CONFIG
+TPU_CFG = TEST_CONFIG.with_backend("tpu")
+
+
+@pytest.fixture(scope="module")
+def refresh_round():
+    """One distributed refresh round's worth of messages (n=3, t=1)."""
+    keys = simulate_keygen(1, 3, CFG)
+    msgs, dks = [], []
+    for key in keys:
+        m, dk = RefreshMessage.distribute(key.i, key, 3, CFG)
+        msgs.append(m)
+        dks.append(dk)
+    return keys, msgs, dks
+
+
+def _pdl_items(keys, msgs, n):
+    from fsdkr_tpu.core.secp256k1 import GENERATOR
+    from fsdkr_tpu.proofs.pdl_slack import PDLwSlackStatement
+
+    key = keys[0]
+    items = []
+    for msg in msgs:
+        for i in range(n):
+            st = PDLwSlackStatement(
+                ciphertext=msg.points_encrypted_vec[i],
+                ek=key.paillier_key_vec[i],
+                Q=msg.points_committed_vec[i],
+                G=GENERATOR,
+                h1=key.h1_h2_n_tilde_vec[i].g,
+                h2=key.h1_h2_n_tilde_vec[i].ni,
+                N_tilde=key.h1_h2_n_tilde_vec[i].N,
+            )
+            items.append((msg.pdl_proof_vec[i], st))
+    return items
+
+
+class TestFamilyParity:
+    """Each family: host and TPU verdict vectors must be identical, on
+    valid batches and on batches with tampered rows."""
+
+    def test_pdl(self, refresh_round):
+        keys, msgs, _ = refresh_round
+        items = _pdl_items(keys, msgs, 3)
+        # tamper row 2: claim a different s1
+        bad = dataclasses.replace(items[2][0], s1=items[2][0].s1 + 1)
+        items[2] = (bad, items[2][1])
+        host = HostBatchVerifier().verify_pdl(items)
+        tpu = TpuBatchVerifier(TPU_CFG).verify_pdl(items)
+        assert host == tpu
+        assert host[2] is not None and all(v is None for i, v in enumerate(host) if i != 2)
+
+    def test_range(self, refresh_round):
+        keys, msgs, _ = refresh_round
+        key = keys[0]
+        items = []
+        for msg in msgs:
+            for i in range(3):
+                items.append(
+                    (
+                        msg.range_proofs[i],
+                        msg.points_encrypted_vec[i],
+                        key.paillier_key_vec[i],
+                        key.h1_h2_n_tilde_vec[i],
+                    )
+                )
+        bad = dataclasses.replace(items[4][0], s2=items[4][0].s2 + 1)
+        items[4] = (bad, *items[4][1:])
+        host = HostBatchVerifier().verify_range(items)
+        tpu = TpuBatchVerifier(TPU_CFG).verify_range(items)
+        assert host == tpu
+        assert host == [i != 4 for i in range(len(items))]
+
+    def test_ring_pedersen(self, refresh_round):
+        _, msgs, _ = refresh_round
+        items = [(m.ring_pedersen_proof, m.ring_pedersen_statement) for m in msgs]
+        bad = dataclasses.replace(
+            items[1][0], Z=[z + 1 for z in items[1][0].Z]
+        )
+        items.append((bad, items[1][1]))
+        host = HostBatchVerifier().verify_ring_pedersen(items, CFG.m_security)
+        tpu = TpuBatchVerifier(TPU_CFG).verify_ring_pedersen(items, CFG.m_security)
+        assert host == tpu == [True, True, True, False]
+
+    def test_correct_key(self, refresh_round):
+        _, msgs, _ = refresh_round
+        items = [(m.dk_correctness_proof, m.ek) for m in msgs]
+        # wrong modulus for row 1's proof
+        items.append((msgs[1].dk_correctness_proof, msgs[0].ek))
+        host = HostBatchVerifier().verify_correct_key(items, CFG.correct_key_rounds)
+        tpu = TpuBatchVerifier(TPU_CFG).verify_correct_key(items, CFG.correct_key_rounds)
+        assert host == tpu == [True, True, True, False]
+
+    def test_composite_dlog(self):
+        from fsdkr_tpu.proofs.composite_dlog import CompositeDLogProof, DLogStatement
+        from fsdkr_tpu.protocol.keygen import generate_dlog_statement_proofs
+
+        st, p1, p2 = generate_dlog_statement_proofs(CFG)
+        st_inv = DLogStatement(N=st.N, g=st.ni, ni=st.g)
+        bogus = CompositeDLogProof.prove(st, 999)
+        items = [(p1, st), (p2, st_inv), (bogus, st)]
+        host = HostBatchVerifier().verify_composite_dlog(items)
+        tpu = TpuBatchVerifier(TPU_CFG).verify_composite_dlog(items)
+        assert host == tpu == [True, True, False]
+
+    def test_empty_batches(self):
+        v = TpuBatchVerifier(TPU_CFG)
+        assert v.verify_pdl([]) == []
+        assert v.verify_range([]) == []
+        assert v.verify_ring_pedersen([], CFG.m_security) == []
+        assert v.verify_correct_key([], CFG.correct_key_rounds) == []
+        assert v.verify_composite_dlog([]) == []
+
+
+class TestCollectOnTpuBackend:
+    def test_full_refresh_tpu_backend(self):
+        """End-to-end: distribute on host, collect entirely through the
+        batched TPU verifier; secret must be preserved."""
+        t, n = 1, 3
+        keys = simulate_keygen(t, n, CFG)
+        old_secret = vss.reconstruct(
+            vss.ShamirSecretSharing(t, n),
+            list(range(t + 1)),
+            [k.keys_linear.x_i for k in keys[: t + 1]],
+        )
+        msgs, dks = [], []
+        for key in keys:
+            m, dk = RefreshMessage.distribute(key.i, key, n, CFG)
+            msgs.append(m)
+            dks.append(dk)
+        for key, dk in zip(keys, dks):
+            RefreshMessage.collect(msgs, key, dk, (), TPU_CFG)
+        new_secret = vss.reconstruct(
+            vss.ShamirSecretSharing(t, n),
+            list(range(t + 1)),
+            [k.keys_linear.x_i for k in keys[: t + 1]],
+        )
+        assert old_secret.v == new_secret.v
+
+    def test_tampered_detected_on_tpu_backend(self):
+        from fsdkr_tpu.errors import FsDkrError
+
+        t, n = 1, 3
+        keys = simulate_keygen(t, n, CFG)
+        msgs, dks = [], []
+        for key in keys:
+            m, dk = RefreshMessage.distribute(key.i, key, n, CFG)
+            msgs.append(m)
+            dks.append(dk)
+        bad = copy.deepcopy(msgs)
+        bad[2].points_encrypted_vec[1] += 1
+        with pytest.raises(FsDkrError):
+            RefreshMessage.collect(bad, keys[1], dks[1], (), TPU_CFG)
