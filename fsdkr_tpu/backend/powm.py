@@ -1,0 +1,90 @@
+"""Pluggable batched modular exponentiation for the *prover* side.
+
+`distribute`'s per-receiver fan-out (SURVEY.md §1 "parallelism note": n
+independent {encrypt, commit, PDL-prove, range-prove} units) is expressed
+against a `batch_powm(bases, exps, moduli) -> list[int]` callable:
+
+- host_powm: CPython pow loop (oracle).
+- tpu_powm: one multi-modulus Montgomery launch per column
+  (fsdkr_tpu.ops.montgomery), with the same padding/bucketing as the
+  verifier backend.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+from ..config import ProtocolConfig, DEFAULT_CONFIG
+
+BatchPowm = Callable[[Sequence[int], Sequence[int], Sequence[int]], List[int]]
+
+# Montgomery contexts keyed by (moduli, limb count): a refresh reuses the
+# same modulus vectors across many launches (fused prover columns, beta^n,
+# r^e, verifier equations), so the per-row host precompute (n', R^2 mod N)
+# and the modulus tensor upload are paid once per vector, not per launch.
+_CTX_CACHE: dict = {}
+_CTX_CACHE_MAX = 64
+
+
+def _cached_ctx(moduli, num_limbs):
+    from ..ops.montgomery import BatchModExp
+
+    key = (hash(tuple(moduli)), num_limbs)
+    ctx = _CTX_CACHE.get(key)
+    if ctx is None or ctx.ctx.moduli != list(moduli):
+        if len(_CTX_CACHE) >= _CTX_CACHE_MAX:
+            _CTX_CACHE.clear()
+        ctx = BatchModExp(moduli, num_limbs)
+        _CTX_CACHE[key] = ctx
+    return ctx
+
+
+def _pad_pow2(rows: int) -> int:
+    """Pad batch sizes to powers of two (>= 8) so kernel shapes — and
+    therefore XLA compilations — are reused across calls and rounds."""
+    return max(8, 1 << (rows - 1).bit_length())
+
+
+def host_powm(bases, exps, moduli) -> List[int]:
+    return [pow(b, e, m) for b, e, m in zip(bases, exps, moduli)]
+
+
+def tpu_powm(bases, exps, moduli) -> List[int]:
+    from ..ops.limbs import limbs_for_bits
+
+    if not bases:
+        return []
+    b = len(bases)
+    pad = _pad_pow2(b) - b
+    bases = list(bases) + [1] * pad
+    exps = list(exps) + [0] * pad
+    moduli = list(moduli) + [3] * pad
+    k = limbs_for_bits(max(m.bit_length() for m in moduli))
+    return _cached_ctx(moduli, k).modexp(bases, exps)[:b]
+
+
+def get_batch_powm(config: ProtocolConfig = DEFAULT_CONFIG) -> BatchPowm:
+    return tpu_powm if config.backend == "tpu" else host_powm
+
+
+def powm_columns(powm: BatchPowm, *columns):
+    """Fuse several (bases, exps, moduli) columns of the same modulus
+    width class into ONE batched launch and split the results back.
+
+    Rationale: a batched modexp costs sequential depth proportional to the
+    *widest* exponent in the batch regardless of row count, so columns with
+    narrow exponents ride free when concatenated with a wide column —
+    turning k launches of depth d_1..d_k into one launch of depth max(d_i).
+    """
+    flat_b, flat_e, flat_m, sizes = [], [], [], []
+    for bases, exps, moduli in columns:
+        flat_b += list(bases)
+        flat_e += list(exps)
+        flat_m += list(moduli)
+        sizes.append(len(bases))
+    res = powm(flat_b, flat_e, flat_m)
+    out, at = [], 0
+    for s in sizes:
+        out.append(res[at : at + s])
+        at += s
+    return out

@@ -35,43 +35,33 @@ from ..core.secp256k1 import N as CURVE_ORDER
 from ..core.secp256k1 import Scalar
 from ..core.transcript import challenge_bits
 from ..ops.limbs import limbs_for_bits
-from ..ops.montgomery import BatchModExp
 from ..proofs import alice_range, correct_key
 from ..proofs.pdl_slack import PDLwSlackProof
 from ..proofs.ring_pedersen import RingPedersenProof
 from .batch_verifier import BatchVerifier, HostBatchVerifier
 
 
-def _pad_pow2(rows: int) -> int:
-    """Pad batch sizes to powers of two (>= 8) so kernel shapes — and
-    therefore XLA compilations — are reused across calls and rounds."""
-    return max(8, 1 << (rows - 1).bit_length())
-
-
 def _modexp(bases, exps, moduli) -> List[int]:
     """One batched multi-modulus modexp launch (rows padded to the widest
-    modulus in the batch and to a power-of-two batch size)."""
-    if not bases:
-        return []
-    b = len(bases)
-    pad = _pad_pow2(b) - b
-    bases = list(bases) + [1] * pad
-    exps = list(exps) + [0] * pad
-    moduli = list(moduli) + [3] * pad
-    k = limbs_for_bits(max(m.bit_length() for m in moduli))
-    return BatchModExp(moduli, k).modexp(bases, exps)[:b]
+    modulus in the batch and to a power-of-two batch size, Montgomery
+    contexts cached per modulus vector — see backend.powm)."""
+    from .powm import tpu_powm
+
+    return tpu_powm(bases, exps, moduli)
 
 
 def _modmul(a, b, moduli) -> List[int]:
     if not a:
         return []
+    from .powm import _cached_ctx, _pad_pow2
+
     rows = len(a)
     pad = _pad_pow2(rows) - rows
     a = list(a) + [1] * pad
     b = list(b) + [1] * pad
     moduli = list(moduli) + [3] * pad
     k = limbs_for_bits(max(m.bit_length() for m in moduli))
-    return BatchModExp(moduli, k).modmul(a, b)[:rows]
+    return _cached_ctx(moduli, k).modmul(a, b)[:rows]
 
 
 class TpuBatchVerifier(BatchVerifier):
@@ -93,21 +83,27 @@ class TpuBatchVerifier(BatchVerifier):
             PDLwSlackProof._challenge(st, p.z, p.u1, p.u2, p.u3) for p, st in items
         ]
 
-        # mod n^2 equation
+        from .powm import powm_columns
+
+        # mod n^2 columns fused into one launch, mod N~ columns into another
         nn_mod = [st.ek.nn for _, st in items]
-        c_e = _modexp([st.ciphertext for _, st in items], e_vec, nn_mod)
-        s2_n = _modexp([p.s2 for p, _ in items], [st.ek.n for _, st in items], nn_mod)
+        nt_mod = [st.N_tilde for _, st in items]
+        c_e, s2_n = powm_columns(
+            _modexp,
+            ([st.ciphertext for _, st in items], e_vec, nn_mod),
+            ([p.s2 for p, _ in items], [st.ek.n for _, st in items], nn_mod),
+        )
+        z_e, h1_s1, h2_s3 = powm_columns(
+            _modexp,
+            ([p.z for p, _ in items], e_vec, nt_mod),
+            ([st.h1 for _, st in items], [p.s1 for p, _ in items], nt_mod),
+            ([st.h2 for _, st in items], [p.s3 for p, _ in items], nt_mod),
+        )
         lhs2 = _modmul([p.u2 for p, _ in items], c_e, nn_mod)
         gs1 = [
             (1 + (p.s1 % st.ek.n) * st.ek.n) % st.ek.nn for p, st in items
         ]
         rhs2 = _modmul(gs1, s2_n, nn_mod)
-
-        # mod N~ equation
-        nt_mod = [st.N_tilde for _, st in items]
-        z_e = _modexp([p.z for p, _ in items], e_vec, nt_mod)
-        h1_s1 = _modexp([st.h1 for _, st in items], [p.s1 for p, _ in items], nt_mod)
-        h2_s3 = _modexp([st.h2 for _, st in items], [p.s3 for p, _ in items], nt_mod)
         lhs3 = _modmul([p.u3 for p, _ in items], z_e, nt_mod)
         rhs3 = _modmul(h1_s1, h2_s3, nt_mod)
 
@@ -132,20 +128,30 @@ class TpuBatchVerifier(BatchVerifier):
         nt_mod = [dlog.N for _, _, _, dlog in items]
         e_vec = [p.e for p, _, _, _ in items]
 
-        z_e = _modexp([p.z for p, _, _, _ in items], e_vec, nt_mod)
-        h1_s1 = _modexp(
-            [dlog.g for _, _, _, dlog in items],
-            [p.s1 for p, _, _, _ in items],
-            nt_mod,
+        from .powm import powm_columns
+
+        z_e, h1_s1, h2_s2 = powm_columns(
+            _modexp,
+            ([p.z for p, _, _, _ in items], e_vec, nt_mod),
+            (
+                [dlog.g for _, _, _, dlog in items],
+                [p.s1 for p, _, _, _ in items],
+                nt_mod,
+            ),
+            (
+                [dlog.ni for _, _, _, dlog in items],
+                [p.s2 for p, _, _, _ in items],
+                nt_mod,
+            ),
         )
-        h2_s2 = _modexp(
-            [dlog.ni for _, _, _, dlog in items],
-            [p.s2 for p, _, _, _ in items],
-            nt_mod,
-        )
-        c_e = _modexp([c for _, c, _, _ in items], e_vec, nn_mod)
-        s_n = _modexp(
-            [p.s for p, _, _, _ in items], [ek.n for _, _, ek, _ in items], nn_mod
+        c_e, s_n = powm_columns(
+            _modexp,
+            ([c for _, c, _, _ in items], e_vec, nn_mod),
+            (
+                [p.s for p, _, _, _ in items],
+                [ek.n for _, _, ek, _ in items],
+                nn_mod,
+            ),
         )
 
         w_part = _modmul(h1_s1, h2_s2, nt_mod)

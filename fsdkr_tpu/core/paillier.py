@@ -18,7 +18,18 @@ from dataclasses import dataclass
 
 from . import intops, primes
 
-__all__ = ["EncryptionKey", "DecryptionKey", "keygen", "encrypt", "encrypt_with_randomness", "decrypt", "add", "mul", "sample_randomness"]
+__all__ = [
+    "EncryptionKey",
+    "DecryptionKey",
+    "keygen",
+    "encrypt",
+    "encrypt_with_randomness",
+    "encrypt_with_randomness_batch",
+    "decrypt",
+    "add",
+    "mul",
+    "sample_randomness",
+]
 
 
 @dataclass(frozen=True)
@@ -67,6 +78,21 @@ def encrypt_with_randomness(ek: EncryptionKey, m: int, r: int) -> int:
         raise ValueError("Paillier randomness must be a unit of Z_n")
     gm = (1 + (m % ek.n) * ek.n) % ek.nn
     return (gm * pow(r, ek.n, ek.nn)) % ek.nn
+
+
+def encrypt_with_randomness_batch(eks, ms, rs, powm=None) -> list:
+    """Batched chosen-randomness encryption: one modexp column r^n mod n^2
+    (the per-receiver encryption fan-out of distribute,
+    `/root/reference/src/refresh_message.rs:72-84`)."""
+    if powm is None:
+        powm = lambda b, e, mod: [pow(x, y, z) for x, y, z in zip(b, e, mod)]
+    for ek, r in zip(eks, rs):
+        if r <= 0 or math.gcd(r, ek.n) != 1:
+            raise ValueError("Paillier randomness must be a unit of Z_n")
+    rn = powm(rs, [ek.n for ek in eks], [ek.nn for ek in eks])
+    return [
+        (1 + (m % ek.n) * ek.n) * x % ek.nn for ek, m, x in zip(eks, ms, rn)
+    ]
 
 
 def encrypt(ek: EncryptionKey, m: int) -> int:

@@ -19,9 +19,9 @@ cleanly.
 Exponentiation is MSB-first fixed-window (4-bit): per window, 4
 Montgomery squarings and one branchless 16-entry table multiply —
 ~1.27 Montgomery multiplications per exponent bit, constant shape.
-Exponent widths are bucketed to powers of two >= 64 (see
-`bucket_exp_bits`), which also caps the number of compiled kernel
-variants.
+Exponent widths are bucketed up a fixed ladder of multiples of 4 (see
+`bucket_exp_bits`), which keeps the sequential depth close to the true
+exponent width while capping the number of compiled kernel variants.
 """
 
 from __future__ import annotations
@@ -45,12 +45,26 @@ __all__ = [
 ]
 
 
+# Exponent-width ladder: wall-clock is proportional to the bucketed width
+# (sequential window loop), so the ladder is finer than powers of two where
+# the protocol's exponent sizes actually fall (q*Ntilde ~ 2304 bits,
+# q^3*Ntilde ~ 2816 bits for 2048-bit moduli). All entries are multiples of
+# 4 (window width); the variant count per (B, K) stays bounded.
+_EXP_BUCKETS = (
+    64, 128, 256, 512, 768, 1024, 1536, 2048, 2560, 3072, 4096,
+    5120, 6144, 8192, 12288, 16384,
+)
+
+
 def bucket_exp_bits(exps) -> int:
-    """Exponent width for a batch: the max bit length rounded up to a
-    power of two >= 64. Guarantees the multiple-of-4 width the windowed
-    kernel requires and caps compiled variants per (B, K) at ~8."""
+    """Exponent width for a batch: the max bit length rounded up the
+    bucket ladder. Guarantees the multiple-of-4 width the windowed kernel
+    requires and caps compiled variants per (B, K)."""
     bits = max((e.bit_length() for e in exps), default=1) or 1
-    return max(64, 1 << (bits - 1).bit_length())
+    for b in _EXP_BUCKETS:
+        if bits <= b:
+            return b
+    return -(-bits // _WINDOW) * _WINDOW
 
 _U32 = jnp.uint32
 
@@ -135,9 +149,9 @@ def _modexp_kernel(base, exp, n, n_prime, r2, one_mont, *, exp_bits):
     Fixed-window exponentiation, MSB-first: per 4-bit window, 4 Montgomery
     squarings and one branchless table multiply (the w=0 entry is the
     Montgomery one, so every window costs the same — no data-dependent
-    control flow). exp_bits must be a multiple of 4 (the bucketing in
-    BatchModExp guarantees powers of two >= 64), so a window never
-    straddles a 16-bit exponent limb.
+    control flow). exp_bits must be a multiple of 4 — guaranteed by
+    `bucket_exp_bits` at every call site — so window shifts are 4-aligned
+    and a window never straddles a 16-bit exponent limb.
     """
     assert exp_bits % _WINDOW == 0
     base_m = mont_mul_limbs(base, r2, n, n_prime)  # to Montgomery domain

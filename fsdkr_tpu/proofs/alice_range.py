@@ -66,29 +66,58 @@ class AliceProof:
         r: int,
         q: int = CURVE_ORDER,
     ) -> "AliceProof":
+        return AliceProof.generate_batch([(a, cipher, alice_ek, dlog_statement, r)], q)[0]
+
+    @staticmethod
+    def generate_batch(items, q: int = CURVE_ORDER, powm=None) -> list["AliceProof"]:
+        """Batched prover over items = [(a, cipher, ek, dlog_statement, r)].
+
+        The per-receiver fan-out of distribute (reference
+        `/root/reference/src/refresh_message.rs:106-116`) runs as six
+        modexp columns (+ one post-challenge column) through `powm` —
+        host pow or one TPU launch per column.
+        """
+        if powm is None:
+            from ..backend.powm import host_powm as powm
         if q.bit_length() > 256:
             raise ValueError("SHA-256 transcripts support group orders up to 256 bits")
-        h1, h2, n_tilde = dlog_statement.g, dlog_statement.ni, dlog_statement.N
-        n, nn = alice_ek.n, alice_ek.nn
         q3 = q**3
+        h1v = [d.g for _, _, _, d, _ in items]
+        h2v = [d.ni for _, _, _, d, _ in items]
+        ntv = [d.N for _, _, _, d, _ in items]
+        nv = [ek.n for _, _, ek, _, _ in items]
+        nnv = [ek.nn for _, _, ek, _, _ in items]
 
-        alpha = secrets.randbelow(q3)
-        beta = intops.sample_unit(n)
-        gamma = secrets.randbelow(q3 * n_tilde)
-        rho = secrets.randbelow(q * n_tilde)
+        alpha = [secrets.randbelow(q3) for _ in items]
+        beta = [intops.sample_unit(n) for n in nv]
+        gamma = [secrets.randbelow(q3 * nt) for nt in ntv]
+        rho = [secrets.randbelow(q * nt) for nt in ntv]
 
-        z = pow(h1, a, n_tilde) * pow(h2, rho, n_tilde) % n_tilde
-        u = (1 + alpha * n) * pow(beta, n, nn) % nn
-        w = pow(h1, alpha, n_tilde) * pow(h2, gamma, n_tilde) % n_tilde
+        from .pdl_slack import batched_commitment_pairs
 
-        e = _challenge(n, cipher, z, u, w)
-        return AliceProof(
-            z=z,
-            e=e,
-            s=pow(r, e, n) * beta % n,
-            s1=e * a + alpha,
-            s2=e * rho + gamma,
+        z, w = batched_commitment_pairs(
+            h1v, h2v, ntv, [a for a, *_ in items], rho, alpha, gamma, powm
         )
+        bn = powm(beta, nv, nnv)
+        u = [(1 + al * n) * x % nn for al, n, nn, x in zip(alpha, nv, nnv, bn)]
+
+        e = [
+            _challenge(n, cipher, zi, ui, wi)
+            for (a, cipher, ek, d, r), n, zi, ui, wi in zip(items, nv, z, u, w)
+        ]
+        re_ = powm([r for *_, r in items], e, nv)
+        return [
+            AliceProof(
+                z=zi,
+                e=ei,
+                s=x * b % n,
+                s1=ei * a + al,
+                s2=ei * ro + ga,
+            )
+            for (a, _, _, _, _), n, zi, ei, x, b, al, ro, ga in zip(
+                items, nv, z, e, re_, beta, alpha, rho, gamma
+            )
+        ]
 
     def verify(
         self,

@@ -63,11 +63,15 @@ class NiCorrectKeyProof:
         dk: DecryptionKey,
         salt: bytes = SALT_STRING,
         rounds: int = DEFAULT_CONFIG.correct_key_rounds,
+        powm=None,
     ) -> "NiCorrectKeyProof":
+        if powm is None:
+            from ..backend.powm import host_powm as powm
         n = dk.p * dk.q
         phi = (dk.p - 1) * (dk.q - 1)
         d = pow(n, -1, phi)  # x -> x^d is the inverse of x -> x^N on Z_N^*
-        sigma = [pow(_derive_rho(n, salt, i), d, n) for i in range(rounds)]
+        rho = [_derive_rho(n, salt, i) for i in range(rounds)]
+        sigma = powm(rho, [d] * rounds, [n] * rounds)
         return NiCorrectKeyProof(sigma_vec=sigma)
 
     def verify(

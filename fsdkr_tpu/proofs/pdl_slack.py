@@ -47,6 +47,21 @@ def commitment_unknown_order(h1: int, h2: int, modulus: int, x: int, r: int) -> 
     )
 
 
+def batched_commitment_pairs(h1v, h2v, ntv, xs1, rs1, xs2, rs2, powm):
+    """Two batched unknown-order commitments per row — (h1^xs1 * h2^rs1,
+    h1^xs2 * h2^rs2) mod N-tilde — with all four exponent columns fused
+    into ONE modexp launch. Shared by the PDL and Alice-range batched
+    provers (their round-1 commitments have this exact shape)."""
+    from ..backend.powm import powm_columns
+
+    c1, c2, c3, c4 = powm_columns(
+        powm, (h1v, xs1, ntv), (h2v, rs1, ntv), (h1v, xs2, ntv), (h2v, rs2, ntv)
+    )
+    first = [a * b % nt for a, b, nt in zip(c1, c2, ntv)]
+    second = [a * b % nt for a, b, nt in zip(c3, c4, ntv)]
+    return first, second
+
+
 @dataclass(frozen=True)
 class PDLwSlackStatement:
     # field set mirrors /root/reference/src/zk_pdl_with_slack.rs:24-32
@@ -92,24 +107,63 @@ class PDLwSlackProof:
 
     @staticmethod
     def prove(witness: PDLwSlackWitness, st: PDLwSlackStatement) -> "PDLwSlackProof":
+        return PDLwSlackProof.prove_batch([witness], [st])[0]
+
+    @staticmethod
+    def prove_batch(
+        witnesses: list[PDLwSlackWitness],
+        statements: list[PDLwSlackStatement],
+        powm=None,
+    ) -> list["PDLwSlackProof"]:
+        """Batched prover: the n-receiver fan-out of distribute (reference
+        `/root/reference/src/refresh_message.rs:87-104`) as modexp columns
+        through `powm` (host pow or one TPU launch per column).
+
+        (1+n)^alpha mod n^2 uses the closed form 1 + (alpha mod n)*n, so
+        the u2 column needs only the beta^n exponentiation.
+        """
+        if powm is None:
+            from ..backend.powm import host_powm as powm
         q = CURVE_ORDER
         q3 = q**3
-        alpha = secrets.randbelow(q3)
-        beta = 1 + secrets.randbelow(st.ek.n - 1)
-        rho = secrets.randbelow(q * st.N_tilde)
-        gamma = secrets.randbelow(q3 * st.N_tilde)
+        ntv = [st.N_tilde for st in statements]
+        nv = [st.ek.n for st in statements]
+        nnv = [st.ek.nn for st in statements]
 
-        z = commitment_unknown_order(st.h1, st.h2, st.N_tilde, witness.x.to_int(), rho)
-        u1 = st.G * Scalar.from_int(alpha)
-        u2 = commitment_unknown_order(st.ek.n + 1, beta, st.ek.nn, alpha, st.ek.n)
-        u3 = commitment_unknown_order(st.h1, st.h2, st.N_tilde, alpha, gamma)
+        alpha = [secrets.randbelow(q3) for _ in statements]
+        beta = [1 + secrets.randbelow(n - 1) for n in nv]
+        rho = [secrets.randbelow(q * nt) for nt in ntv]
+        gamma = [secrets.randbelow(q3 * nt) for nt in ntv]
 
-        e = PDLwSlackProof._challenge(st, z, u1, u2, u3)
+        h1v = [st.h1 for st in statements]
+        h2v = [st.h2 for st in statements]
+        z, u3 = batched_commitment_pairs(
+            h1v, h2v, ntv,
+            [w.x.to_int() for w in witnesses], rho, alpha, gamma, powm,
+        )
+        u1 = [st.G * Scalar.from_int(al) for st, al in zip(statements, alpha)]
+        bn = powm(beta, nv, nnv)
+        u2 = [(1 + (al % n) * n) * x % nn for al, n, nn, x in zip(alpha, nv, nnv, bn)]
 
-        s1 = e * witness.x.to_int() + alpha
-        s2 = commitment_unknown_order(witness.r, beta, st.ek.n, e, 1)
-        s3 = e * rho + gamma
-        return PDLwSlackProof(z=z, u1=u1, u2=u2, u3=u3, s1=s1, s2=s2, s3=s3)
+        e = [
+            PDLwSlackProof._challenge(st, zi, u1i, u2i, u3i)
+            for st, zi, u1i, u2i, u3i in zip(statements, z, u1, u2, u3)
+        ]
+        re_ = powm([w.r for w in witnesses], e, nv)
+        return [
+            PDLwSlackProof(
+                z=zi,
+                u1=u1i,
+                u2=u2i,
+                u3=u3i,
+                s1=ei * w.x.to_int() + al,
+                s2=x * b % n,
+                s3=ei * ro + ga,
+            )
+            for w, n, zi, u1i, u2i, u3i, ei, x, b, al, ro, ga in zip(
+                witnesses, nv, z, u1, u2, u3, e, re_, beta, alpha, rho, gamma
+            )
+        ]
 
     def verify(self, st: PDLwSlackStatement) -> None:
         """Raises PDLwSlackProofError with per-equation booleans on failure

@@ -79,9 +79,13 @@ class RingPedersenProof:
         witness: RingPedersenWitness,
         st: RingPedersenStatement,
         m_security: int = DEFAULT_CONFIG.m_security,
+        powm=None,
     ) -> "RingPedersenProof":
+        if powm is None:
+            from ..backend.powm import host_powm as powm
         a_vec = [secrets.randbelow(witness.phi) for _ in range(m_security)]
-        A_vec = [pow(st.T, a_i, st.N) for a_i in a_vec]
+        # the M-round commitment column is one batched modexp launch
+        A_vec = powm([st.T] * m_security, a_vec, [st.N] * m_security)
         e = RingPedersenProof._challenge(A_vec)
         bits = challenge_bits(e, m_security)
         Z_vec = [

@@ -96,48 +96,61 @@ class RefreshMessage:
 
         points_committed_vec = [GENERATOR * s for s in secret_shares]
 
-        points_encrypted_vec: List[int] = []
-        randomness_vec: List[int] = []
-        for i, s in enumerate(secret_shares):
-            ek_i = local_key.paillier_key_vec[i]
-            r = paillier.sample_randomness(ek_i)
-            points_encrypted_vec.append(
-                paillier.encrypt_with_randomness(ek_i, s.to_int(), r)
-            )
-            randomness_vec.append(r)
+        # the whole per-receiver fan-out below (encrypt + PDL prove + range
+        # prove, reference :72-116) runs as batched modexp columns through
+        # the configured backend
+        from ..backend.powm import get_batch_powm
 
-        pdl_proof_vec = []
-        for i, s in enumerate(secret_shares):
-            st = PDLwSlackStatement(
+        powm = get_batch_powm(config)
+        receiver_eks = [local_key.paillier_key_vec[i] for i in range(new_n)]
+        randomness_vec = [paillier.sample_randomness(ek_i) for ek_i in receiver_eks]
+        points_encrypted_vec = paillier.encrypt_with_randomness_batch(
+            receiver_eks,
+            [s.to_int() for s in secret_shares],
+            randomness_vec,
+            powm,
+        )
+
+        statements = [
+            PDLwSlackStatement(
                 ciphertext=points_encrypted_vec[i],
-                ek=local_key.paillier_key_vec[i],
+                ek=receiver_eks[i],
                 Q=points_committed_vec[i],
                 G=GENERATOR,
                 h1=local_key.h1_h2_n_tilde_vec[i].g,
                 h2=local_key.h1_h2_n_tilde_vec[i].ni,
                 N_tilde=local_key.h1_h2_n_tilde_vec[i].N,
             )
-            pdl_proof_vec.append(
-                PDLwSlackProof.prove(PDLwSlackWitness(x=s, r=randomness_vec[i]), st)
-            )
-
-        range_proofs = [
-            AliceProof.generate(
-                secret_shares[i].to_int(),
-                points_encrypted_vec[i],
-                local_key.paillier_key_vec[i],
-                local_key.h1_h2_n_tilde_vec[i],
-                randomness_vec[i],
-            )
-            for i in range(len(secret_shares))
+            for i in range(new_n)
         ]
+        witnesses = [
+            PDLwSlackWitness(x=s, r=r)
+            for s, r in zip(secret_shares, randomness_vec)
+        ]
+        pdl_proof_vec = PDLwSlackProof.prove_batch(witnesses, statements, powm)
+
+        range_proofs = AliceProof.generate_batch(
+            [
+                (
+                    secret_shares[i].to_int(),
+                    points_encrypted_vec[i],
+                    receiver_eks[i],
+                    local_key.h1_h2_n_tilde_vec[i],
+                    randomness_vec[i],
+                )
+                for i in range(new_n)
+            ],
+            powm=powm,
+        )
 
         ek, dk = paillier.keygen(config.paillier_bits)
         dk_correctness_proof = NiCorrectKeyProof.proof(
-            dk, rounds=config.correct_key_rounds
+            dk, rounds=config.correct_key_rounds, powm=powm
         )
         rp_statement, rp_witness = RingPedersenStatement.generate(config)
-        rp_proof = RingPedersenProof.prove(rp_witness, rp_statement, config.m_security)
+        rp_proof = RingPedersenProof.prove(
+            rp_witness, rp_statement, config.m_security, powm
+        )
 
         msg = RefreshMessage(
             old_party_index=old_party_index,
