@@ -49,6 +49,21 @@ def host_powm(bases, exps, moduli) -> List[int]:
     return [pow(b, e, m) for b, e, m in zip(bases, exps, moduli)]
 
 
+def tpu_modmul(a, b, moduli) -> List[int]:
+    """Row-wise a*b mod moduli as one padded multi-modulus launch."""
+    if not a:
+        return []
+    from ..ops.limbs import limbs_for_bits
+
+    rows = len(a)
+    pad = _pad_pow2(rows) - rows
+    a = list(a) + [1] * pad
+    b = list(b) + [1] * pad
+    moduli = list(moduli) + [3] * pad
+    k = limbs_for_bits(max(m.bit_length() for m in moduli))
+    return _cached_ctx(moduli, k).modmul(a, b)[:rows]
+
+
 def tpu_powm(bases, exps, moduli) -> List[int]:
     from ..ops.limbs import limbs_for_bits
 
@@ -63,8 +78,74 @@ def tpu_powm(bases, exps, moduli) -> List[int]:
     return _cached_ctx(moduli, k).modexp(bases, exps)[:b]
 
 
+def tpu_powm_shared(bases, exps_per_group, moduli) -> List[List[int]]:
+    """Fixed-base comb launch: bases[g]^exps_per_group[g][m] mod moduli[g].
+
+    Group count and rows-per-group are padded to powers of two (dummy
+    groups use modulus 3, dummy rows exponent 0) so compiled kernel shapes
+    are reused across committee sizes.
+    """
+    from ..ops.limbs import limbs_for_bits
+    from ..ops.montgomery import shared_base_modexp
+
+    if not bases:
+        return []
+    g = len(bases)
+    g_pad = max(2, 1 << (g - 1).bit_length())
+    m_max = max((len(e) for e in exps_per_group), default=1) or 1
+    m_pad = max(8, 1 << (m_max - 1).bit_length())
+    bases = list(bases) + [1] * (g_pad - g)
+    moduli = list(moduli) + [3] * (g_pad - g)
+    exps = [list(e) + [0] * (m_pad - len(e)) for e in exps_per_group]
+    exps += [[0] * m_pad] * (g_pad - g)
+    k = limbs_for_bits(max(m.bit_length() for m in moduli))
+    out = shared_base_modexp(bases, exps, moduli, k, ctx=_cached_ctx(moduli, k).ctx)
+    return [out[i][: len(exps_per_group[i])] for i in range(g)]
+
+
+# Below this row count, a (base, modulus) group takes the generic windowed
+# kernel: the comb's per-group ladder only pays for itself once its cost is
+# amortized over enough rows.
+_SHARED_MIN_ROWS = 4
+
+
+def tpu_powm_grouped(bases, exps, moduli) -> List[int]:
+    """Like tpu_powm, but rows sharing a (base, modulus) pair are routed
+    through the fixed-base comb kernel; loner rows take the generic path.
+
+    This is the shape of the collect() columns: ring-Pedersen rows share
+    (T, N) per message and PDL/range rows share (h1|h2, N~) per receiver,
+    so almost everything lands in a comb group.
+    """
+    groups: dict = {}
+    for i, (b, m) in enumerate(zip(bases, moduli)):
+        groups.setdefault((b, m), []).append(i)
+    shared = [(k, rows) for k, rows in groups.items() if len(rows) >= _SHARED_MIN_ROWS]
+    loners = [i for k, rows in groups.items() if len(rows) < _SHARED_MIN_ROWS for i in rows]
+
+    out: List = [None] * len(bases)
+    if shared:
+        res = tpu_powm_shared(
+            [k[0] for k, _ in shared],
+            [[exps[i] for i in rows] for _, rows in shared],
+            [k[1] for k, _ in shared],
+        )
+        for (_, rows), vals in zip(shared, res):
+            for i, v in zip(rows, vals):
+                out[i] = v
+    if loners:
+        vals = tpu_powm(
+            [bases[i] for i in loners],
+            [exps[i] for i in loners],
+            [moduli[i] for i in loners],
+        )
+        for i, v in zip(loners, vals):
+            out[i] = v
+    return out
+
+
 def get_batch_powm(config: ProtocolConfig = DEFAULT_CONFIG) -> BatchPowm:
-    return tpu_powm if config.backend == "tpu" else host_powm
+    return tpu_powm_grouped if config.backend == "tpu" else host_powm
 
 
 def powm_columns(powm: BatchPowm, *columns):

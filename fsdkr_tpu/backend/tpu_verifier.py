@@ -42,26 +42,19 @@ from .batch_verifier import BatchVerifier, HostBatchVerifier
 
 
 def _modexp(bases, exps, moduli) -> List[int]:
-    """One batched multi-modulus modexp launch (rows padded to the widest
-    modulus in the batch and to a power-of-two batch size, Montgomery
-    contexts cached per modulus vector — see backend.powm)."""
-    from .powm import tpu_powm
+    """One batched multi-modulus modexp launch. Rows sharing a (base,
+    modulus) pair — ring-Pedersen's (T, N) per message, PDL/range's
+    (h1|h2, N~) per receiver — ride the fixed-base comb kernel; the rest
+    take the generic windowed kernel (see backend.powm)."""
+    from .powm import tpu_powm_grouped
 
-    return tpu_powm(bases, exps, moduli)
+    return tpu_powm_grouped(bases, exps, moduli)
 
 
 def _modmul(a, b, moduli) -> List[int]:
-    if not a:
-        return []
-    from .powm import _cached_ctx, _pad_pow2
+    from .powm import tpu_modmul
 
-    rows = len(a)
-    pad = _pad_pow2(rows) - rows
-    a = list(a) + [1] * pad
-    b = list(b) + [1] * pad
-    moduli = list(moduli) + [3] * pad
-    k = limbs_for_bits(max(m.bit_length() for m in moduli))
-    return _cached_ctx(moduli, k).modmul(a, b)[:rows]
+    return tpu_modmul(a, b, moduli)
 
 
 class TpuBatchVerifier(BatchVerifier):

@@ -86,6 +86,11 @@ def encrypt_with_randomness_batch(eks, ms, rs, powm=None) -> list:
     `/root/reference/src/refresh_message.rs:72-84`)."""
     if powm is None:
         powm = lambda b, e, mod: [pow(x, y, z) for x, y, z in zip(b, e, mod)]
+    if not (len(eks) == len(ms) == len(rs)):
+        raise ValueError(
+            f"batch length mismatch: {len(eks)} keys, {len(ms)} plaintexts, "
+            f"{len(rs)} randomness values"
+        )
     for ek, r in zip(eks, rs):
         if r <= 0 or math.gcd(r, ek.n) != 1:
             raise ValueError("Paillier randomness must be a unit of Z_n")

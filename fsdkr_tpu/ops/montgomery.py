@@ -42,6 +42,7 @@ __all__ = [
     "batch_modmul",
     "bucket_exp_bits",
     "BatchModExp",
+    "shared_base_modexp",
 ]
 
 
@@ -188,6 +189,108 @@ def _modexp_kernel(base, exp, n, n_prime, r2, one_mont, *, exp_bits):
     return mont_mul_limbs(acc, one, n, n_prime)
 
 
+@partial(jax.jit, static_argnames=("exp_bits",))
+def _shared_modexp_kernel(base, exp, n, n_prime, r2, one_mont, powers=None, *, exp_bits):
+    """result[g, m] = base[g]^exp[g, m] mod n[g] — fixed-base comb.
+
+    The O(n^2) verification loop has whole columns whose rows share one
+    (base, modulus) pair: every ring-Pedersen row of a message shares
+    (T, N) (`/root/reference/src/ring_pedersen_proof.rs:144`), and the n
+    PDL/range rows addressed to one receiver share that receiver's
+    (h1|h2, N~) (`src/zk_pdl_with_slack.rs:129-157`). For such a column
+    the per-row squaring chain of the generic windowed kernel is wasted:
+    precompute the base's window powers ONCE per group, then each row is
+    only one table multiply per window.
+
+    Cost per group of M rows at exp_bits=E (vs generic windowed kernel):
+      ladder:   E squarings            on G-row batches   (amortized /M)
+      table:    14 E/4 muls            on (W*G)-row batches, depth 4
+      per-row:  E/4 muls + 2           on (G*M)-row batches
+    i.e. heavy-batch work drops from ~1.27*E to ~0.25*E muls per row.
+
+    base: (G, K); exp: (G, M, EL) limbs; n/r2/one_mont: (G, K);
+    n_prime: (G,). Returns (G, M, K).
+    """
+    assert exp_bits % _WINDOW == 0
+    g, k = base.shape
+    m = exp.shape[1]
+    w_cnt = exp_bits // _WINDOW
+
+    if powers is None:
+        base_m = mont_mul_limbs(base, r2, n, n_prime)
+
+        # Ladder: powers[w] = base_m^(16^w). Sequential squarings, but on
+        # G rows only — this is the chain the comb amortizes over the M rows.
+        def ladder_step(w, carry):
+            p, pws = carry
+            pws = lax.dynamic_update_index_in_dim(pws, p, w, axis=0)
+            for _ in range(_WINDOW):
+                p = mont_mul_limbs(p, p, n, n_prime)
+            return p, pws
+
+        powers0 = jnp.zeros((w_cnt, g, k), _U32)
+        _, powers = lax.fori_loop(0, w_cnt, ladder_step, (base_m, powers0))
+
+    # Table entries c = powers^c for c = 1..15, built in log depth over a
+    # flattened (W*G) batch: {2}, {3,4}, {5..8}, {9..15}.
+    nf = jnp.broadcast_to(n[None], (w_cnt, g, k)).reshape(w_cnt * g, k)
+    npf = jnp.broadcast_to(n_prime[None], (w_cnt, g)).reshape(w_cnt * g)
+    p1 = powers.reshape(w_cnt * g, k)
+
+    def mulf(a, b):
+        return mont_mul_limbs(a, b, nf, npf)
+
+    def mul_many(pairs):
+        # one batched launch for a whole level: concat rows, split back
+        a = jnp.concatenate([x for x, _ in pairs], axis=0)
+        b = jnp.concatenate([y for _, y in pairs], axis=0)
+        n_rep = jnp.concatenate([nf] * len(pairs), axis=0)
+        np_rep = jnp.concatenate([npf] * len(pairs), axis=0)
+        out = mont_mul_limbs(a, b, n_rep, np_rep)
+        return [
+            out[i * w_cnt * g : (i + 1) * w_cnt * g] for i in range(len(pairs))
+        ]
+
+    p2 = mulf(p1, p1)
+    p3, p4 = mul_many([(p2, p1), (p2, p2)])
+    p5, p6, p7, p8 = mul_many([(p4, p1), (p4, p2), (p4, p3), (p4, p4)])
+    p9, p10, p11, p12, p13, p14, p15 = mul_many(
+        [(p8, p1), (p8, p2), (p8, p3), (p8, p4), (p8, p5), (p8, p6), (p8, p7)]
+    )
+    one_f = jnp.broadcast_to(one_mont[None], (w_cnt, g, k)).reshape(w_cnt * g, k)
+    # table: (16, W, G, K)
+    table = jnp.stack(
+        [t.reshape(w_cnt, g, k) for t in
+         (one_f, p1, p2, p3, p4, p5, p6, p7, p8, p9, p10, p11, p12, p13, p14, p15)],
+        axis=0,
+    )
+
+    # Accumulation: one table multiply per window on the (G*M)-row batch.
+    n_rows = jnp.broadcast_to(n[:, None], (g, m, k)).reshape(g * m, k)
+    np_rows = jnp.broadcast_to(n_prime[:, None], (g, m)).reshape(g * m)
+    acc0 = jnp.broadcast_to(one_mont[:, None], (g, m, k)).reshape(g * m, k)
+    idx = jnp.arange(1 << _WINDOW, dtype=_U32)[:, None, None, None]
+
+    def acc_step(w, acc):
+        shift = _WINDOW * w
+        limb = lax.dynamic_index_in_dim(
+            exp, shift // LIMB_BITS, axis=2, keepdims=False
+        )  # (G, M)
+        d = (limb >> (shift % LIMB_BITS)) & ((1 << _WINDOW) - 1)
+        entries = lax.dynamic_index_in_dim(table, w, axis=1, keepdims=False)
+        # branchless per-row pick of entries[d[g,m], g, :] -> (G, M, K)
+        sel = jnp.sum(
+            jnp.where(d[None, :, :, None] == idx, entries[:, :, None, :], jnp.uint32(0)),
+            axis=0,
+        )
+        return mont_mul_limbs(acc, sel.reshape(g * m, k), n_rows, np_rows)
+
+    acc = lax.fori_loop(0, w_cnt, acc_step, acc0)
+    one = jnp.zeros_like(acc).at[:, 0].set(1)
+    out = mont_mul_limbs(acc, one, n_rows, np_rows)
+    return out.reshape(g, m, k)
+
+
 @jax.jit
 def _modmul_kernel(a, b, n, n_prime, r2):
     """a*b mod n per row (via a*R * b * R^{-1})."""
@@ -239,6 +342,75 @@ class BatchModExp:
             self._r2,
         )
         return limbs_to_ints(np.asarray(out))
+
+
+# Below this group count the comb's power ladder runs on the host: 4*E
+# sequential device squarings on a handful of rows underfeed the chip,
+# while the host pays G * E/4 CPython `pow(p, 16, n)` steps (~10 ms per
+# 2048-bit group). Above it, the G-row device batch is wide enough.
+_HOST_LADDER_MAX_GROUPS = 64
+
+
+def shared_base_modexp(
+    bases: Sequence[int],
+    exps_per_group: Sequence[Sequence[int]],
+    moduli: Sequence[int],
+    num_limbs: int,
+    host_ladder: bool | None = None,
+    ctx: MontgomeryContext | None = None,
+) -> List[List[int]]:
+    """bases[g]^exps_per_group[g][m] mod moduli[g] via the fixed-base comb.
+
+    Groups may have unequal row counts; rows are padded to the widest group
+    with exponent 0 (base^0 = 1, discarded on the way out). Callers with a
+    stable modulus vector pass a cached MontgomeryContext (backend.powm).
+    """
+    g_cnt = len(bases)
+    if g_cnt == 0:
+        return []
+    m_max = max(len(e) for e in exps_per_group)
+    exp_bits = bucket_exp_bits([e for grp in exps_per_group for e in grp])
+    el = -(-exp_bits // LIMB_BITS)
+
+    if ctx is None:
+        ctx = MontgomeryContext(moduli, num_limbs)
+    flat_exps: List[int] = []
+    for grp in exps_per_group:
+        flat_exps.extend(list(grp) + [0] * (m_max - len(grp)))
+    exp_limbs = ints_to_limbs(flat_exps, el).reshape(g_cnt, m_max, el)
+
+    if host_ladder is None:
+        host_ladder = g_cnt <= _HOST_LADDER_MAX_GROUPS
+    powers = None
+    if host_ladder:
+        w_cnt = exp_bits // _WINDOW
+        r = 1 << (LIMB_BITS * num_limbs)
+        flat_powers: List[int] = []
+        for b, n in zip(bases, ctx.moduli):
+            p = b % n
+            for _ in range(w_cnt):
+                flat_powers.append(p * r % n)  # Montgomery domain
+                p = pow(p, 1 << _WINDOW, n)
+        powers = jnp.asarray(
+            ints_to_limbs(flat_powers, num_limbs)
+            .reshape(g_cnt, w_cnt, num_limbs)
+            .transpose(1, 0, 2)
+        )
+
+    out = _shared_modexp_kernel(
+        jnp.asarray(ints_to_limbs([b % n for b, n in zip(bases, ctx.moduli)], num_limbs)),
+        jnp.asarray(exp_limbs),
+        jnp.asarray(ctx.n),
+        jnp.asarray(ctx.n_prime),
+        jnp.asarray(ctx.r2),
+        jnp.asarray(ctx.one_mont),
+        powers,
+        exp_bits=exp_bits,
+    )
+    flat = limbs_to_ints(np.asarray(out).reshape(g_cnt * m_max, num_limbs))
+    return [
+        flat[g * m_max : g * m_max + len(exps_per_group[g])] for g in range(g_cnt)
+    ]
 
 
 def batch_modexp(

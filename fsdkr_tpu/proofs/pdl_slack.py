@@ -124,6 +124,11 @@ class PDLwSlackProof:
         """
         if powm is None:
             from ..backend.powm import host_powm as powm
+        if len(witnesses) != len(statements):
+            raise ValueError(
+                f"batch length mismatch: {len(witnesses)} witnesses, "
+                f"{len(statements)} statements"
+            )
         q = CURVE_ORDER
         q3 = q**3
         ntv = [st.N_tilde for st in statements]

@@ -9,7 +9,12 @@ import pytest
 
 from fsdkr_tpu.core import primes
 from fsdkr_tpu.ops import limbs
-from fsdkr_tpu.ops.montgomery import BatchModExp, batch_modexp, batch_modmul
+from fsdkr_tpu.ops.montgomery import (
+    BatchModExp,
+    batch_modexp,
+    batch_modmul,
+    shared_base_modexp,
+)
 
 
 class TestLimbs:
@@ -94,6 +99,55 @@ class TestBatchModExp:
                 pow(b, e, n) for b, e, n in zip(bases, exps, moduli)
             ]
 
+class TestSharedBaseModExp:
+    """The fixed-base comb kernel: groups share (base, modulus), exactly
+    the shape of the ring-Pedersen and PDL/range verification columns."""
+
+    @pytest.mark.parametrize("bits", [256, 768])
+    @pytest.mark.parametrize("host_ladder", [True, False])
+    def test_vs_host_oracle(self, bits, host_ladder):
+        G, M = 3, 6
+        moduli = _random_moduli(bits, G)
+        bases = [secrets.randbelow(n) for n in moduli]
+        exps = [[secrets.randbits(bits) for _ in range(M)] for _ in range(G)]
+        got = shared_base_modexp(
+            bases, exps, moduli, limbs.limbs_for_bits(bits), host_ladder=host_ladder
+        )
+        assert got == [
+            [pow(b, e, n) for e in grp]
+            for b, grp, n in zip(bases, exps, moduli)
+        ]
+
+    def test_ragged_groups_and_edge_exponents(self):
+        bits = 512
+        moduli = _random_moduli(bits, 3)
+        bases = [secrets.randbelow(n) for n in moduli]
+        exps = [
+            [0, 1, 2],
+            [secrets.randbits(512)],
+            [15, 16, 17, (1 << 512) - 1, secrets.randbits(40)],
+        ]
+        got = shared_base_modexp(bases, exps, moduli, limbs.limbs_for_bits(bits))
+        assert got == [
+            [pow(b, e, n) for e in grp]
+            for b, grp, n in zip(bases, exps, moduli)
+        ]
+
+    def test_grouped_router_matches_host(self):
+        from fsdkr_tpu.backend.powm import tpu_powm_grouped
+
+        bits = 512
+        n1, n2 = _random_moduli(bits, 2)
+        b1, b2 = secrets.randbelow(n1), secrets.randbelow(n2)
+        # 5 rows sharing (b1, n1) -> comb; 2 loner rows -> generic kernel
+        bases = [b1] * 5 + [b2, secrets.randbelow(n2)]
+        moduli = [n1] * 5 + [n2, n2]
+        exps = [secrets.randbits(bits) for _ in bases]
+        got = tpu_powm_grouped(bases, exps, moduli)
+        assert got == [pow(b, e, n) for b, e, n in zip(bases, exps, moduli)]
+
+
+class TestBatchModExpCarry:
     def test_worst_case_carry_chains(self):
         # moduli / operands built from long 0xffff runs stress the lazy
         # carry normalization and the borrow scan
