@@ -1,0 +1,282 @@
+// Native host bignum core for fsdkr_tpu.
+//
+// The reference's host-serial native layer is GMP (C) underneath
+// curv/kzen-paillier — e.g. the 2048-bit Paillier keygen at
+// /root/reference/src/refresh_message.rs:118 and the ring-Pedersen setup at
+// src/ring_pedersen_proof.rs:48-74 are GMP prime generation and modexp.
+// This file is the rebuild's equivalent: fixed-width Montgomery arithmetic
+// over 64-bit limbs (unsigned __int128 partial products), exposed as a
+// plain C ABI loaded from Python via ctypes (no pybind11 in this
+// environment). It serves the host-serial paths the TPU cannot batch:
+// Miller-Rabin prime generation, the comb kernel's host power ladder, and
+// the host-backend oracle's modular exponentiation.
+//
+// All numbers are little-endian uint64 limb arrays of a caller-chosen
+// width; moduli must be odd. Maximum width 64 limbs = 4096 bits (the
+// protocol's widest modulus class, N^2 for 2048-bit Paillier N).
+
+#include <cstdint>
+#include <cstring>
+
+typedef uint64_t u64;
+typedef unsigned __int128 u128;
+
+static const int MAXL = 64; // 4096 bits
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// limb helpers
+
+static int cmp_limbs(const u64 *a, const u64 *b, int L) {
+  for (int i = L - 1; i >= 0; i--) {
+    if (a[i] < b[i])
+      return -1;
+    if (a[i] > b[i])
+      return 1;
+  }
+  return 0;
+}
+
+static void sub_limbs(u64 *out, const u64 *a, const u64 *b, int L) {
+  u64 borrow = 0;
+  for (int i = 0; i < L; i++) {
+    u64 bi = b[i] + borrow;
+    u64 new_borrow = (bi < b[i]) || (a[i] < bi);
+    out[i] = a[i] - bi;
+    borrow = new_borrow;
+  }
+}
+
+// -n^{-1} mod 2^64 by Newton iteration (n odd)
+static u64 mont_n0inv(u64 n0) {
+  u64 x = n0; // 3 correct bits
+  for (int i = 0; i < 6; i++)
+    x *= 2 - n0 * x; // doubles correct bits each round
+  return (u64)0 - x;
+}
+
+// ---------------------------------------------------------------------------
+// Montgomery CIOS multiplication: out = a * b * R^{-1} mod n, R = 2^(64 L)
+
+static void mont_mul(u64 *out, const u64 *a, const u64 *b, const u64 *n,
+                     u64 n0inv, int L) {
+  u64 t[MAXL + 2];
+  std::memset(t, 0, sizeof(u64) * (L + 2));
+  for (int i = 0; i < L; i++) {
+    u128 carry = 0;
+    const u64 ai = a[i];
+    for (int j = 0; j < L; j++) {
+      u128 cur = (u128)ai * b[j] + t[j] + carry;
+      t[j] = (u64)cur;
+      carry = cur >> 64;
+    }
+    u128 cur = (u128)t[L] + carry;
+    t[L] = (u64)cur;
+    t[L + 1] += (u64)(cur >> 64);
+
+    const u64 m = t[0] * n0inv;
+    carry = ((u128)m * n[0] + t[0]) >> 64;
+    for (int j = 1; j < L; j++) {
+      u128 cur2 = (u128)m * n[j] + t[j] + carry;
+      t[j - 1] = (u64)cur2;
+      carry = cur2 >> 64;
+    }
+    cur = (u128)t[L] + carry;
+    t[L - 1] = (u64)cur;
+    t[L] = t[L + 1] + (u64)(cur >> 64);
+    t[L + 1] = 0;
+  }
+  if (t[L] != 0 || cmp_limbs(t, n, L) >= 0)
+    sub_limbs(out, t, n, L); // t < 2n always, one subtract suffices
+  else
+    std::memcpy(out, t, sizeof(u64) * L);
+}
+
+// R mod n and R^2 mod n by doubling (L <= MAXL)
+static void mont_constants(const u64 *n, int L, u64 *r_mod, u64 *r2_mod) {
+  // r_mod = R mod n: start from 2^(64L - 1) mod n (top bit), double once
+  u64 acc[MAXL];
+  std::memset(acc, 0, sizeof(u64) * L);
+  // set acc = 1, then double 64*L times mod n
+  acc[0] = 1;
+  for (int bit = 0; bit < 64 * L; bit++) {
+    // acc = 2*acc mod n
+    u64 carry = 0;
+    for (int i = 0; i < L; i++) {
+      u64 hi = acc[i] >> 63;
+      acc[i] = (acc[i] << 1) | carry;
+      carry = hi;
+    }
+    if (carry || cmp_limbs(acc, n, L) >= 0)
+      sub_limbs(acc, acc, n, L);
+  }
+  std::memcpy(r_mod, acc, sizeof(u64) * L);
+  // r2_mod = R^2 mod n: double 64*L more times
+  for (int bit = 0; bit < 64 * L; bit++) {
+    u64 carry = 0;
+    for (int i = 0; i < L; i++) {
+      u64 hi = acc[i] >> 63;
+      acc[i] = (acc[i] << 1) | carry;
+      carry = hi;
+    }
+    if (carry || cmp_limbs(acc, n, L) >= 0)
+      sub_limbs(acc, acc, n, L);
+  }
+  std::memcpy(r2_mod, acc, sizeof(u64) * L);
+}
+
+// ---------------------------------------------------------------------------
+// modexp: out = base^exp mod n. n odd, L limbs; exp EL limbs.
+// 4-bit fixed window, MSB-first.
+
+int fsdkr_modexp(const u64 *base, const u64 *exp, const u64 *n, u64 *out,
+                 int L, int EL) {
+  if (L <= 0 || L > MAXL || EL <= 0 || !(n[0] & 1))
+    return -1;
+
+  const u64 n0inv = mont_n0inv(n[0]);
+  u64 one_m[MAXL], r2[MAXL];
+  mont_constants(n, L, one_m, r2);
+
+  // reduce base below n (base < 2^(64L); subtract n a few times if needed —
+  // callers pass base < n, this is just a guard)
+  u64 b[MAXL];
+  std::memcpy(b, base, sizeof(u64) * L);
+  while (cmp_limbs(b, n, L) >= 0)
+    sub_limbs(b, b, n, L);
+
+  u64 base_m[MAXL];
+  mont_mul(base_m, b, r2, n, n0inv, L);
+
+  // window table: t[w] = base^w in Montgomery form
+  u64 table[16][MAXL];
+  std::memcpy(table[0], one_m, sizeof(u64) * L);
+  std::memcpy(table[1], base_m, sizeof(u64) * L);
+  for (int w = 2; w < 16; w++)
+    mont_mul(table[w], table[w - 1], base_m, n, n0inv, L);
+
+  // top set window
+  int top_bit = -1;
+  for (int i = EL - 1; i >= 0 && top_bit < 0; i--)
+    if (exp[i])
+      for (int bit = 63; bit >= 0; bit--)
+        if ((exp[i] >> bit) & 1) {
+          top_bit = i * 64 + bit;
+          break;
+        }
+  u64 acc[MAXL];
+  if (top_bit < 0) { // exp == 0
+    std::memcpy(out, one_m, sizeof(u64) * L);
+    u64 onev[MAXL];
+    std::memset(onev, 0, sizeof(u64) * L);
+    onev[0] = 1;
+    mont_mul(out, out, onev, n, n0inv, L); // leave Montgomery domain -> 1
+    return 0;
+  }
+
+  int nwin = top_bit / 4; // highest window index
+  std::memcpy(acc, one_m, sizeof(u64) * L);
+  for (int w = nwin; w >= 0; w--) {
+    for (int s = 0; s < 4; s++)
+      mont_mul(acc, acc, acc, n, n0inv, L);
+    // 4-bit windows never straddle a 64-bit limb (bit0 is a multiple of 4)
+    int bit0 = w * 4;
+    u64 d = (exp[bit0 / 64] >> (bit0 % 64)) & 0xF;
+    mont_mul(acc, acc, table[d], n, n0inv, L);
+  }
+
+  u64 onev[MAXL];
+  std::memset(onev, 0, sizeof(u64) * L);
+  onev[0] = 1;
+  mont_mul(out, acc, onev, n, n0inv, L);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Miller-Rabin: 1 = probable prime, 0 = composite, -1 = bad input.
+// Witness bases are caller-provided (sampled with a CSPRNG in Python) so
+// the native side stays deterministic and testable.
+
+int fsdkr_miller_rabin(const u64 *n, int L, const u64 *witnesses, int rounds) {
+  if (L <= 0 || L > MAXL || !(n[0] & 1))
+    return -1;
+
+  const u64 n0inv = mont_n0inv(n[0]);
+  u64 one_m[MAXL], r2[MAXL];
+  mont_constants(n, L, one_m, r2);
+
+  // n1 = n - 1 = 2^r * d
+  u64 n1[MAXL], d[MAXL];
+  u64 onev[MAXL];
+  std::memset(onev, 0, sizeof(u64) * L);
+  onev[0] = 1;
+  sub_limbs(n1, n, onev, L);
+  std::memcpy(d, n1, sizeof(u64) * L);
+  int r = 0;
+  while (!(d[0] & 1)) {
+    for (int i = 0; i < L - 1; i++)
+      d[i] = (d[i] >> 1) | (d[i + 1] << 63);
+    d[L - 1] >>= 1;
+    r++;
+  }
+
+  u64 n1_m[MAXL]; // n-1 in Montgomery form, for comparisons
+  mont_mul(n1_m, n1, r2, n, n0inv, L);
+
+  for (int round = 0; round < rounds; round++) {
+    const u64 *a = witnesses + (size_t)round * L;
+    u64 a_m[MAXL];
+    u64 ared[MAXL];
+    std::memcpy(ared, a, sizeof(u64) * L);
+    while (cmp_limbs(ared, n, L) >= 0)
+      sub_limbs(ared, ared, n, L);
+    mont_mul(a_m, ared, r2, n, n0inv, L);
+
+    // x = a^d mod n (Montgomery domain, square-and-multiply MSB-first)
+    int top_bit = -1;
+    for (int i = L - 1; i >= 0 && top_bit < 0; i--)
+      if (d[i])
+        for (int bit = 63; bit >= 0; bit--)
+          if ((d[i] >> bit) & 1) {
+            top_bit = i * 64 + bit;
+            break;
+          }
+    u64 x[MAXL];
+    std::memcpy(x, one_m, sizeof(u64) * L);
+    for (int bit = top_bit; bit >= 0; bit--) {
+      mont_mul(x, x, x, n, n0inv, L);
+      if ((d[bit / 64] >> (bit % 64)) & 1)
+        mont_mul(x, x, a_m, n, n0inv, L);
+    }
+
+    if (cmp_limbs(x, one_m, L) == 0 || cmp_limbs(x, n1_m, L) == 0)
+      continue;
+    bool witness = true;
+    for (int i = 0; i < r - 1; i++) {
+      mont_mul(x, x, x, n, n0inv, L);
+      if (cmp_limbs(x, n1_m, L) == 0) {
+        witness = false;
+        break;
+      }
+    }
+    if (witness)
+      return 0; // composite
+  }
+  return 1; // probable prime
+}
+
+// Batched modexp over a column of rows (independent moduli): the host
+// backend's powm shape. Returns 0 on success, -1 on any bad row input.
+int fsdkr_modexp_batch(const u64 *bases, const u64 *exps, const u64 *mods,
+                       u64 *outs, int rows, int L, int EL) {
+  for (int i = 0; i < rows; i++) {
+    int rc = fsdkr_modexp(bases + (size_t)i * L, exps + (size_t)i * EL,
+                          mods + (size_t)i * L, outs + (size_t)i * L, L, EL);
+    if (rc != 0)
+      return rc;
+  }
+  return 0;
+}
+
+} // extern "C"

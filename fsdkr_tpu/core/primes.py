@@ -33,12 +33,23 @@ _PRIMORIAL = _primorial()
 
 
 def is_probable_prime(n: int, rounds: int = 30) -> bool:
-    """Miller-Rabin with `rounds` random bases (error <= 4^-rounds)."""
+    """Miller-Rabin with `rounds` random bases (error <= 4^-rounds).
+
+    Dispatches to the native Montgomery core (fsdkr_tpu.native, the
+    rebuild's GMP-equivalent) when available; the pure-Python path below
+    is the fallback and differential oracle."""
     if n < 2:
         return False
     for small in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37):
         if n % small == 0:
             return n == small
+
+    from .. import native
+
+    verdict = native.is_probable_prime(n, rounds)
+    if verdict is not None:
+        return verdict
+
     d = n - 1
     r = (d & -d).bit_length() - 1
     d >>= r

@@ -1,0 +1,162 @@
+"""ctypes bridge to the native host bignum core (csrc/fsdkr_native.cpp).
+
+The reference's host-serial native layer is GMP under curv/kzen-paillier
+(`/root/reference/Cargo.toml:42-44` selects the GMP backend by default);
+this module is the rebuild's equivalent for the paths that stay on the
+host: Miller-Rabin prime generation, the comb kernel's power ladder, and
+the host-backend modexp oracle. The shared object is compiled on first
+use with g++ (no pybind11 in this environment — plain C ABI + ctypes) and
+cached next to this file; every entry point degrades to the pure-Python
+implementation when the toolchain is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import secrets
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+__all__ = [
+    "available",
+    "modexp",
+    "modexp_batch",
+    "is_probable_prime",
+]
+
+_LIMB_BYTES = 8
+_MAX_LIMBS = 64  # 4096 bits, keep in sync with MAXL in csrc
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc", "fsdkr_native.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "_fsdkr_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return None
+    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(src):
+        cmd = [
+            "g++", "-O3", "-march=native", "-shared", "-fPIC",
+            "-o", _SO + ".tmp", src,
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(_SO + ".tmp", _SO)
+        except (subprocess.SubprocessError, OSError):
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    lib.fsdkr_modexp.restype = ctypes.c_int
+    lib.fsdkr_modexp_batch.restype = ctypes.c_int
+    lib.fsdkr_miller_rabin.restype = ctypes.c_int
+    return lib
+
+
+def _get() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if not _tried:
+        with _lock:
+            if not _tried:
+                _lib = _build()
+                _tried = True
+    return _lib
+
+
+def available() -> bool:
+    return _get() is not None
+
+
+def _limbs_for(x: int) -> int:
+    return max(1, -(-x.bit_length() // 64))
+
+
+def _to_buf(xs: Sequence[int], limbs: int) -> ctypes.Array:
+    buf = bytearray()
+    for x in xs:
+        buf += x.to_bytes(limbs * _LIMB_BYTES, "little")
+    return (ctypes.c_uint64 * (len(xs) * limbs)).from_buffer_copy(bytes(buf))
+
+
+def _from_buf(buf, rows: int, limbs: int) -> List[int]:
+    raw = bytes(buf)
+    step = limbs * _LIMB_BYTES
+    return [
+        int.from_bytes(raw[i * step : (i + 1) * step], "little") for i in range(rows)
+    ]
+
+
+def modexp(base: int, exp: int, mod: int) -> int:
+    """base^exp mod mod via the native Montgomery core; CPython pow when
+    the native library is unavailable or the modulus is out of range."""
+    lib = _get()
+    L = _limbs_for(mod)
+    if lib is None or L > _MAX_LIMBS or mod % 2 == 0 or mod <= 1:
+        return pow(base, exp, mod)
+    EL = max(1, _limbs_for(exp))
+    out = (ctypes.c_uint64 * L)()
+    rc = lib.fsdkr_modexp(
+        _to_buf([base % mod], L), _to_buf([exp], EL), _to_buf([mod], L), out, L, EL
+    )
+    if rc != 0:
+        return pow(base, exp, mod)
+    return _from_buf(out, 1, L)[0]
+
+
+def modexp_batch(
+    bases: Sequence[int], exps: Sequence[int], mods: Sequence[int]
+) -> List[int]:
+    """Row-wise bases^exps mod mods. Rows are padded to the widest modulus
+    and exponent in the batch; even/oversized-modulus rows fall back to
+    CPython pow row-wise."""
+    if not bases:
+        return []
+    if not (len(bases) == len(exps) == len(mods)):
+        raise ValueError("batch length mismatch")
+    lib = _get()
+    L = max(_limbs_for(m) for m in mods)
+    if (
+        lib is None
+        or L > _MAX_LIMBS
+        or any(m % 2 == 0 or m <= 1 for m in mods)
+    ):
+        return [pow(b, e, m) for b, e, m in zip(bases, exps, mods)]
+    EL = max(1, max(_limbs_for(e) for e in exps))
+    rows = len(bases)
+    out = (ctypes.c_uint64 * (rows * L))()
+    rc = lib.fsdkr_modexp_batch(
+        _to_buf([b % m for b, m in zip(bases, mods)], L),
+        _to_buf(list(exps), EL),
+        _to_buf(list(mods), L),
+        out,
+        rows,
+        L,
+        EL,
+    )
+    if rc != 0:
+        return [pow(b, e, m) for b, e, m in zip(bases, exps, mods)]
+    return _from_buf(out, rows, L)
+
+
+def is_probable_prime(n: int, rounds: int = 30) -> Optional[bool]:
+    """Miller-Rabin with CSPRNG witnesses, native squaring loop. Returns
+    None when the native path cannot handle the input (caller falls back
+    to the Python implementation)."""
+    lib = _get()
+    L = _limbs_for(n)
+    if lib is None or L > _MAX_LIMBS or n < 5 or n % 2 == 0:
+        return None
+    witnesses = [2 + secrets.randbelow(n - 3) for _ in range(rounds)]
+    rc = lib.fsdkr_miller_rabin(
+        _to_buf([n], L), L, _to_buf(witnesses, L), rounds
+    )
+    if rc < 0:
+        return None
+    return bool(rc)

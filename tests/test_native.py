@@ -1,0 +1,72 @@
+"""Differential tests for the native host bignum core (csrc/ via ctypes):
+the rebuild's equivalent of the reference's GMP layer. Skipped entirely if
+the toolchain is unavailable (every caller has a pure-Python fallback)."""
+
+import secrets
+
+import pytest
+
+from fsdkr_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no g++?)"
+)
+
+
+class TestModexp:
+    @pytest.mark.parametrize("bits", [64, 512, 2048, 4096])
+    def test_vs_pow(self, bits):
+        for _ in range(3):
+            n = secrets.randbits(bits) | (1 << (bits - 1)) | 1
+            b, e = secrets.randbits(bits), secrets.randbits(bits)
+            assert native.modexp(b, e, n) == pow(b, e, n)
+
+    def test_edge_exponents(self):
+        n = secrets.randbits(512) | (1 << 511) | 1
+        for e in (0, 1, 2, 15, 16, 17, n - 1):
+            assert native.modexp(3, e, n) == pow(3, e, n)
+
+    def test_base_reduction(self):
+        n = secrets.randbits(256) | (1 << 255) | 1
+        assert native.modexp(n + 7, 13, n) == pow(n + 7, 13, n)
+
+    def test_even_modulus_falls_back(self):
+        # even moduli are outside Montgomery range: must still be correct
+        assert native.modexp(7, 5, 100) == pow(7, 5, 100)
+
+    def test_batch(self):
+        mods = [secrets.randbits(1024) | (1 << 1023) | 1 for _ in range(6)]
+        bs = [secrets.randbits(1024) for _ in mods]
+        es = [secrets.randbits(700) for _ in mods]
+        assert native.modexp_batch(bs, es, mods) == [
+            pow(b, e, m) for b, e, m in zip(bs, es, mods)
+        ]
+
+    def test_batch_length_mismatch(self):
+        with pytest.raises(ValueError):
+            native.modexp_batch([1, 2], [3], [5, 7])
+
+
+class TestMillerRabin:
+    def test_known_primes(self):
+        for p in (2**127 - 1, 2**521 - 1, 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141):
+            assert native.is_probable_prime(p, 30) is True
+
+    def test_known_composites(self):
+        assert native.is_probable_prime((2**127 - 1) * (2**89 - 1), 30) is False
+        # Carmichael number: classic Fermat-test trap
+        assert native.is_probable_prime(561, 30) is False
+
+    def test_vs_sympy(self):
+        import sympy
+
+        for bits in (64, 256):
+            for _ in range(10):
+                c = secrets.randbits(bits) | 1 | (1 << (bits - 1))
+                assert native.is_probable_prime(c, 30) == sympy.isprime(c)
+
+    def test_primes_module_dispatch(self):
+        from fsdkr_tpu.core import primes
+
+        p = primes.gen_prime(256)
+        assert native.is_probable_prime(p, 30) is True
