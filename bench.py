@@ -55,11 +55,11 @@ def main():
     t_keygen = time.time() - t0
 
     t0 = time.time()
-    msgs, dks = [], []
-    for key in keys:
-        m, dk = RefreshMessage.distribute(key.i, key, n, cfg)
-        msgs.append(m)
-        dks.append(dk)
+    results = RefreshMessage.distribute_batch(
+        [(key.i, key) for key in keys], n, tpu_cfg
+    )
+    msgs = [m for m, _ in results]
+    dks = [dk for _, dk in results]
     t_distribute = time.time() - t0
     log(f"setup done: keygen {t_keygen:.1f}s, distribute {t_distribute:.1f}s")
 

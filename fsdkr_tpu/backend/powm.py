@@ -154,23 +154,30 @@ def get_batch_powm(config: ProtocolConfig = DEFAULT_CONFIG) -> BatchPowm:
 
 
 def powm_columns(powm: BatchPowm, *columns):
-    """Fuse several (bases, exps, moduli) columns of the same modulus
-    width class into ONE batched launch and split the results back.
+    """Fuse several (bases, exps, moduli) columns into per-exponent-width
+    batched launches and split the results back.
 
-    Rationale: a batched modexp costs sequential depth proportional to the
-    *widest* exponent in the batch regardless of row count, so columns with
-    narrow exponents ride free when concatenated with a wide column —
-    turning k launches of depth d_1..d_k into one launch of depth max(d_i).
+    Columns are fused ONLY within the same bucketed exponent width: a
+    batched modexp costs sequential depth proportional to the widest
+    exponent in the batch, so a 256-bit-challenge column concatenated
+    with a 2048-bit column would do ~8x its necessary work riding the
+    wide launch. Same-width columns still share one launch (row count is
+    nearly free next to depth).
     """
-    flat_b, flat_e, flat_m, sizes = [], [], [], []
-    for bases, exps, moduli in columns:
-        flat_b += list(bases)
-        flat_e += list(exps)
-        flat_m += list(moduli)
-        sizes.append(len(bases))
-    res = powm(flat_b, flat_e, flat_m)
-    out, at = [], 0
-    for s in sizes:
-        out.append(res[at : at + s])
-        at += s
+    from ..ops.limbs import bucket_exp_bits
+
+    flat: dict = {}  # width class -> (bases, exps, moduli, [(col, lo, hi)])
+    for col, (bases, exps, moduli) in enumerate(columns):
+        w = bucket_exp_bits(exps)
+        b, e, m, spans = flat.setdefault(w, ([], [], [], []))
+        spans.append((col, len(b), len(b) + len(bases)))
+        b += list(bases)
+        e += list(exps)
+        m += list(moduli)
+
+    out: list = [None] * len(columns)
+    for b, e, m, spans in flat.values():
+        res = powm(b, e, m)
+        for col, lo, hi in spans:
+            out[col] = res[lo:hi]
     return out

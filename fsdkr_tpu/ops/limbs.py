@@ -19,11 +19,37 @@ LIMB_MASK = (1 << LIMB_BITS) - 1
 __all__ = [
     "LIMB_BITS",
     "LIMB_MASK",
+    "WINDOW_BITS",
     "limbs_for_bits",
+    "bucket_exp_bits",
     "ints_to_limbs",
     "limbs_to_ints",
     "MontgomeryContext",
 ]
+
+WINDOW_BITS = 4  # fixed-window width of the modexp kernels
+
+# Exponent-width ladder: modexp wall-clock is proportional to the bucketed
+# width (sequential window loop), so the ladder is finer than powers of two
+# where the protocol's exponent sizes actually fall (q*Ntilde ~ 2304 bits,
+# q^3*Ntilde ~ 2816 bits for 2048-bit moduli). All entries are multiples of
+# the window width; the compiled-variant count per batch shape stays bounded.
+_EXP_BUCKETS = (
+    64, 128, 256, 512, 768, 1024, 1536, 2048, 2560, 3072, 4096,
+    5120, 6144, 8192, 12288, 16384,
+)
+
+
+def bucket_exp_bits(exps) -> int:
+    """Exponent width for a batch: the max bit length rounded up the
+    bucket ladder. Guarantees the multiple-of-window width the kernels
+    require and caps compiled variants per batch shape. Pure host math —
+    deliberately jax-free for the host-backend prover path."""
+    bits = max((e.bit_length() for e in exps), default=1) or 1
+    for b in _EXP_BUCKETS:
+        if bits <= b:
+            return b
+    return -(-bits // WINDOW_BITS) * WINDOW_BITS
 
 
 def limbs_for_bits(bits: int) -> int:

@@ -34,7 +34,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .limbs import LIMB_BITS, LIMB_MASK, MontgomeryContext, ints_to_limbs, limbs_to_ints
+from .limbs import (
+    LIMB_BITS,
+    LIMB_MASK,
+    WINDOW_BITS,
+    MontgomeryContext,
+    bucket_exp_bits,
+    ints_to_limbs,
+    limbs_to_ints,
+)
 
 __all__ = [
     "mont_mul_limbs",
@@ -45,27 +53,6 @@ __all__ = [
     "shared_base_modexp",
 ]
 
-
-# Exponent-width ladder: wall-clock is proportional to the bucketed width
-# (sequential window loop), so the ladder is finer than powers of two where
-# the protocol's exponent sizes actually fall (q*Ntilde ~ 2304 bits,
-# q^3*Ntilde ~ 2816 bits for 2048-bit moduli). All entries are multiples of
-# 4 (window width); the variant count per (B, K) stays bounded.
-_EXP_BUCKETS = (
-    64, 128, 256, 512, 768, 1024, 1536, 2048, 2560, 3072, 4096,
-    5120, 6144, 8192, 12288, 16384,
-)
-
-
-def bucket_exp_bits(exps) -> int:
-    """Exponent width for a batch: the max bit length rounded up the
-    bucket ladder. Guarantees the multiple-of-4 width the windowed kernel
-    requires and caps compiled variants per (B, K)."""
-    bits = max((e.bit_length() for e in exps), default=1) or 1
-    for b in _EXP_BUCKETS:
-        if bits <= b:
-            return b
-    return -(-bits // _WINDOW) * _WINDOW
 
 _U32 = jnp.uint32
 
@@ -140,7 +127,7 @@ def mont_mul_limbs(x, y, n, n_prime):
     return _cond_subtract(t[:, : k + 1], n)
 
 
-_WINDOW = 4  # 4-bit fixed windows: 4 squarings + 1 table multiply per window
+_WINDOW = WINDOW_BITS  # 4-bit fixed windows: 4 squarings + 1 table multiply
 
 
 @partial(jax.jit, static_argnames=("exp_bits",))

@@ -65,14 +65,32 @@ class NiCorrectKeyProof:
         rounds: int = DEFAULT_CONFIG.correct_key_rounds,
         powm=None,
     ) -> "NiCorrectKeyProof":
+        return NiCorrectKeyProof.proof_batch([dk], salt, rounds, powm)[0]
+
+    @staticmethod
+    def proof_batch(
+        dks: List[DecryptionKey],
+        salt: bytes = SALT_STRING,
+        rounds: int = DEFAULT_CONFIG.correct_key_rounds,
+        powm=None,
+    ) -> List["NiCorrectKeyProof"]:
+        """All provers' N-th-root columns in ONE modexp launch (the
+        cross-sender batch axis of a refresh, SURVEY.md §1)."""
         if powm is None:
             from ..backend.powm import host_powm as powm
-        n = dk.p * dk.q
-        phi = (dk.p - 1) * (dk.q - 1)
-        d = pow(n, -1, phi)  # x -> x^d is the inverse of x -> x^N on Z_N^*
-        rho = [_derive_rho(n, salt, i) for i in range(rounds)]
-        sigma = powm(rho, [d] * rounds, [n] * rounds)
-        return NiCorrectKeyProof(sigma_vec=sigma)
+        bases, exps, mods = [], [], []
+        for dk in dks:
+            n = dk.p * dk.q
+            phi = (dk.p - 1) * (dk.q - 1)
+            d = pow(n, -1, phi)  # x -> x^d inverts x -> x^N on Z_N^*
+            bases += [_derive_rho(n, salt, i) for i in range(rounds)]
+            exps += [d] * rounds
+            mods += [n] * rounds
+        sigma = powm(bases, exps, mods)
+        return [
+            NiCorrectKeyProof(sigma_vec=sigma[k * rounds : (k + 1) * rounds])
+            for k in range(len(dks))
+        ]
 
     def verify(
         self,

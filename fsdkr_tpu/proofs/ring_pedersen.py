@@ -81,18 +81,45 @@ class RingPedersenProof:
         m_security: int = DEFAULT_CONFIG.m_security,
         powm=None,
     ) -> "RingPedersenProof":
+        return RingPedersenProof.prove_batch([witness], [st], m_security, powm)[0]
+
+    @staticmethod
+    def prove_batch(
+        witnesses: List[RingPedersenWitness],
+        statements: List[RingPedersenStatement],
+        m_security: int = DEFAULT_CONFIG.m_security,
+        powm=None,
+    ) -> List["RingPedersenProof"]:
+        """All provers' M-round commitment columns in ONE modexp launch;
+        each prover's rows share (T, N), so the fixed-base comb kernel
+        picks them up as a group."""
         if powm is None:
             from ..backend.powm import host_powm as powm
-        a_vec = [secrets.randbelow(witness.phi) for _ in range(m_security)]
-        # the M-round commitment column is one batched modexp launch
-        A_vec = powm([st.T] * m_security, a_vec, [st.N] * m_security)
-        e = RingPedersenProof._challenge(A_vec)
-        bits = challenge_bits(e, m_security)
-        Z_vec = [
-            (a_i + (witness.lam if b else 0)) % witness.phi
-            for a_i, b in zip(a_vec, bits)
+        if len(witnesses) != len(statements):
+            raise ValueError(
+                f"batch length mismatch: {len(witnesses)} witnesses, "
+                f"{len(statements)} statements"
+            )
+        a_all = [
+            [secrets.randbelow(w.phi) for _ in range(m_security)]
+            for w in witnesses
         ]
-        return RingPedersenProof(A=A_vec, Z=Z_vec)
+        A_all = powm(
+            [st.T for st in statements for _ in range(m_security)],
+            [a for grp in a_all for a in grp],
+            [st.N for st in statements for _ in range(m_security)],
+        )
+        out = []
+        for k, (witness, a_vec) in enumerate(zip(witnesses, a_all)):
+            A_vec = A_all[k * m_security : (k + 1) * m_security]
+            e = RingPedersenProof._challenge(A_vec)
+            bits = challenge_bits(e, m_security)
+            Z_vec = [
+                (a_i + (witness.lam if b else 0)) % witness.phi
+                for a_i, b in zip(a_vec, bits)
+            ]
+            out.append(RingPedersenProof(A=A_vec, Z=Z_vec))
+        return out
 
     def verify(
         self,

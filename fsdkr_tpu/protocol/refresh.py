@@ -84,91 +84,135 @@ class RefreshMessage:
         Returns the broadcast message and the *new* Paillier decryption key,
         which the caller feeds back into `collect`.
         """
-        t = local_key.t
-        if t > new_n // 2:
-            raise PartiesThresholdViolation(threshold=t, refreshed_keys=new_n)
-        if new_n <= t:
-            raise NewPartyUnassignedIndexError()
+        return RefreshMessage.distribute_batch(
+            [(old_party_index, local_key)], new_n, config
+        )[0]
 
-        secret = local_key.keys_linear.x_i
-        scheme, secret_shares = vss.share(t, new_n, secret)
-        local_key.vss_scheme = scheme
+    @staticmethod
+    def distribute_batch(
+        senders: Sequence[Tuple[int, LocalKey]],
+        new_n: int,
+        config: ProtocolConfig = DEFAULT_CONFIG,
+    ) -> List[Tuple["RefreshMessage", DecryptionKey]]:
+        """All senders' paths as fused cross-party batches.
 
-        points_committed_vec = [GENERATOR * s for s in secret_shares]
-
-        # the whole per-receiver fan-out below (encrypt + PDL prove + range
-        # prove, reference :72-116) runs as batched modexp columns through
-        # the configured backend
+        The reference runs each sender's fan-out serially (one
+        `distribute` per party); here the per-receiver columns of every
+        sender concatenate into ONE launch per proof family, widening each
+        batch by the sender count — the cross-sender batch axis of
+        SURVEY.md §1. `distribute` is the single-sender special case.
+        Mutates each local_key.vss_scheme.
+        """
         from ..backend.powm import get_batch_powm
 
         powm = get_batch_powm(config)
-        receiver_eks = [local_key.paillier_key_vec[i] for i in range(new_n)]
-        randomness_vec = [paillier.sample_randomness(ek_i) for ek_i in receiver_eks]
-        points_encrypted_vec = paillier.encrypt_with_randomness_batch(
-            receiver_eks,
-            [s.to_int() for s in secret_shares],
-            randomness_vec,
+
+        per = []  # per-sender working state, in input order
+        for old_party_index, local_key in senders:
+            t = local_key.t
+            if t > new_n // 2:
+                raise PartiesThresholdViolation(threshold=t, refreshed_keys=new_n)
+            if new_n <= t:
+                raise NewPartyUnassignedIndexError()
+
+            scheme, secret_shares = vss.share(
+                t, new_n, local_key.keys_linear.x_i
+            )
+            local_key.vss_scheme = scheme
+            receiver_eks = [local_key.paillier_key_vec[i] for i in range(new_n)]
+            randomness_vec = [
+                paillier.sample_randomness(ek_i) for ek_i in receiver_eks
+            ]
+            per.append(
+                dict(
+                    old_i=old_party_index,
+                    key=local_key,
+                    scheme=scheme,
+                    shares=secret_shares,
+                    eks=receiver_eks,
+                    rand=randomness_vec,
+                    points=[GENERATOR * s for s in secret_shares],
+                )
+            )
+
+        # ---- fused encryption column over all (sender, receiver) pairs
+        flat_enc = paillier.encrypt_with_randomness_batch(
+            [ek for p in per for ek in p["eks"]],
+            [s.to_int() for p in per for s in p["shares"]],
+            [r for p in per for r in p["rand"]],
             powm,
         )
+        for k, p in enumerate(per):
+            p["enc"] = flat_enc[k * new_n : (k + 1) * new_n]
 
-        statements = [
+        # ---- fused PDL + range prover columns
+        flat_statements = [
             PDLwSlackStatement(
-                ciphertext=points_encrypted_vec[i],
-                ek=receiver_eks[i],
-                Q=points_committed_vec[i],
+                ciphertext=p["enc"][i],
+                ek=p["eks"][i],
+                Q=p["points"][i],
                 G=GENERATOR,
-                h1=local_key.h1_h2_n_tilde_vec[i].g,
-                h2=local_key.h1_h2_n_tilde_vec[i].ni,
-                N_tilde=local_key.h1_h2_n_tilde_vec[i].N,
+                h1=p["key"].h1_h2_n_tilde_vec[i].g,
+                h2=p["key"].h1_h2_n_tilde_vec[i].ni,
+                N_tilde=p["key"].h1_h2_n_tilde_vec[i].N,
             )
+            for p in per
             for i in range(new_n)
         ]
-        witnesses = [
+        flat_witnesses = [
             PDLwSlackWitness(x=s, r=r)
-            for s, r in zip(secret_shares, randomness_vec)
+            for p in per
+            for s, r in zip(p["shares"], p["rand"])
         ]
-        pdl_proof_vec = PDLwSlackProof.prove_batch(witnesses, statements, powm)
+        flat_pdl = PDLwSlackProof.prove_batch(flat_witnesses, flat_statements, powm)
 
-        range_proofs = AliceProof.generate_batch(
+        flat_range = AliceProof.generate_batch(
             [
                 (
-                    secret_shares[i].to_int(),
-                    points_encrypted_vec[i],
-                    receiver_eks[i],
-                    local_key.h1_h2_n_tilde_vec[i],
-                    randomness_vec[i],
+                    p["shares"][i].to_int(),
+                    p["enc"][i],
+                    p["eks"][i],
+                    p["key"].h1_h2_n_tilde_vec[i],
+                    p["rand"][i],
                 )
+                for p in per
                 for i in range(new_n)
             ],
             powm=powm,
         )
 
-        ek, dk = paillier.keygen(config.paillier_bits)
-        dk_correctness_proof = NiCorrectKeyProof.proof(
-            dk, rounds=config.correct_key_rounds, powm=powm
+        # ---- per-sender keygens (host-serial, native Miller-Rabin) and
+        # fused correct-key / ring-Pedersen prover columns
+        ek_dk = [paillier.keygen(config.paillier_bits) for _ in per]
+        ck_proofs = NiCorrectKeyProof.proof_batch(
+            [dk for _, dk in ek_dk], rounds=config.correct_key_rounds, powm=powm
         )
-        rp_statement, rp_witness = RingPedersenStatement.generate(config)
-        rp_proof = RingPedersenProof.prove(
-            rp_witness, rp_statement, config.m_security, powm
+        rp = [RingPedersenStatement.generate(config) for _ in per]
+        rp_proofs = RingPedersenProof.prove_batch(
+            [w for _, w in rp], [st for st, _ in rp], config.m_security, powm
         )
 
-        msg = RefreshMessage(
-            old_party_index=old_party_index,
-            party_index=local_key.i,
-            pdl_proof_vec=pdl_proof_vec,
-            range_proofs=range_proofs,
-            coefficients_committed_vec=scheme,
-            points_committed_vec=points_committed_vec,
-            points_encrypted_vec=points_encrypted_vec,
-            dk_correctness_proof=dk_correctness_proof,
-            dlog_statement=local_key.h1_h2_n_tilde_vec[local_key.i - 1],
-            ek=ek,
-            remove_party_indices=[],
-            public_key=local_key.y_sum_s,
-            ring_pedersen_statement=rp_statement,
-            ring_pedersen_proof=rp_proof,
-        )
-        return msg, dk
+        out = []
+        for k, p in enumerate(per):
+            local_key = p["key"]
+            msg = RefreshMessage(
+                old_party_index=p["old_i"],
+                party_index=local_key.i,
+                pdl_proof_vec=flat_pdl[k * new_n : (k + 1) * new_n],
+                range_proofs=flat_range[k * new_n : (k + 1) * new_n],
+                coefficients_committed_vec=p["scheme"],
+                points_committed_vec=p["points"],
+                points_encrypted_vec=p["enc"],
+                dk_correctness_proof=ck_proofs[k],
+                dlog_statement=local_key.h1_h2_n_tilde_vec[local_key.i - 1],
+                ek=ek_dk[k][0],
+                remove_party_indices=[],
+                public_key=local_key.y_sum_s,
+                ring_pedersen_statement=rp[k][0],
+                ring_pedersen_proof=rp_proofs[k],
+            )
+            out.append((msg, ek_dk[k][1]))
+        return out
 
     # ------------------------------------------------------------------
     @staticmethod

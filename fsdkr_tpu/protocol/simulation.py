@@ -41,13 +41,12 @@ def simulate_dkr(
 ) -> tuple[List[RefreshMessage], List[DecryptionKey]]:
     """Full refresh round: everyone distributes, everyone collects
     (reference `src/test.rs:311-334`)."""
-    broadcast: List[RefreshMessage] = []
-    new_dks: List[DecryptionKey] = []
     n = len(keys)
-    for key in keys:
-        msg, dk = RefreshMessage.distribute(key.i, key, n, config)
-        broadcast.append(msg)
-        new_dks.append(dk)
+    results = RefreshMessage.distribute_batch(
+        [(key.i, key) for key in keys], n, config
+    )
+    broadcast: List[RefreshMessage] = [m for m, _ in results]
+    new_dks: List[DecryptionKey] = [dk for _, dk in results]
     for i, key in enumerate(keys):
         RefreshMessage.collect(broadcast, key, new_dks[i], (), config)
     return broadcast, new_dks

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Phase profile of RefreshMessage.collect on the TPU backend.
+
+Builds (or loads from .bench_cache/) a full-size refresh workload, then
+times each batch-verifier family and the host-side glue separately.
+Env: PROF_N, PROF_T, PROF_BITS, PROF_M (default full size n=16).
+"""
+
+import copy
+import os
+import pickle
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def load_workload(n, t, bits, m_sec, cfg):
+    from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+
+    cache_dir = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".bench_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    path = os.path.join(cache_dir, f"wl_{n}_{t}_{bits}_{m_sec}.pkl")
+    if os.path.exists(path):
+        log(f"loading cached workload {path}")
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    t0 = time.time()
+    keys = simulate_keygen(t, n, cfg)
+    log(f"keygen: {time.time()-t0:.1f}s")
+    t0 = time.time()
+    results = RefreshMessage.distribute_batch([(key.i, key) for key in keys], n, cfg)
+    msgs = [m for m, _ in results]
+    dks = [dk for _, dk in results]
+    log(f"distribute_batch x{n}: {time.time()-t0:.1f}s")
+    wl = (keys, msgs, dks)
+    with open(path, "wb") as f:
+        pickle.dump(wl, f)
+    return wl
+
+
+def main():
+    n = int(os.environ.get("PROF_N", "16"))
+    t = int(os.environ.get("PROF_T", "8"))
+    bits = int(os.environ.get("PROF_BITS", "2048"))
+    m_sec = int(os.environ.get("PROF_M", "256"))
+
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    except Exception:
+        pass
+
+    from fsdkr_tpu.config import ProtocolConfig
+    from fsdkr_tpu.backend import tpu_verifier
+    from fsdkr_tpu.backend.batch_verifier import BatchVerifier
+    from fsdkr_tpu.protocol import RefreshMessage
+
+    cfg = ProtocolConfig(paillier_bits=bits, m_security=m_sec, backend="tpu")
+    keys, msgs, dks = load_workload(n, t, bits, m_sec, cfg)
+
+    # wrap every verifier family with a timer
+    times = {}
+    verifier_cls = tpu_verifier.TpuBatchVerifier
+    for name in (
+        "verify_pdl",
+        "verify_range",
+        "verify_ring_pedersen",
+        "verify_correct_key",
+        "verify_composite_dlog",
+        "validate_feldman",
+    ):
+        orig = getattr(verifier_cls, name)
+
+        def wrap(orig=orig, name=name):
+            def inner(self, *a, **kw):
+                t0 = time.time()
+                out = orig(self, *a, **kw)
+                times[name] = times.get(name, 0.0) + time.time() - t0
+                return out
+            return inner
+
+        setattr(verifier_cls, name, wrap())
+
+    for run in ("cold", "warm"):
+        times.clear()
+        key = copy.deepcopy(keys[0])
+        dk = dks[0]
+        t0 = time.time()
+        RefreshMessage.collect(list(msgs), key, dk, [], cfg)
+        total = time.time() - t0
+        log(f"--- {run}: collect total {total:.2f}s")
+        acc = 0.0
+        for name, dt in sorted(times.items(), key=lambda kv: -kv[1]):
+            log(f"    {name:24s} {dt:7.2f}s")
+            acc += dt
+        log(f"    {'(host glue / other)':24s} {total-acc:7.2f}s")
+
+
+if __name__ == "__main__":
+    main()
