@@ -1,0 +1,448 @@
+"""RNS (residue number system) Montgomery modexp: the MXU path.
+
+The CIOS limb kernel (ops.montgomery) is VPU-bound: every 2048-bit
+Montgomery product is ~128 sequential carry-coupled vector steps. In RNS
+the same product decomposes over ~130 independent 16-bit prime channels
+(CRT), where multiplication is elementwise and the only cross-channel
+work is *base extension* — and base extension is a literal matrix
+multiplication: q_B = xi (B, k) @ T (k, k) with a SHARED constant matrix.
+That routes the O(k^2) heart of every modular multiplication through the
+MXU systolic array, which is what the n=256 < 1 s north-star needs
+(`BASELINE.json`); the reference's serial GMP `mod_pow` calls
+(`/root/reference/src/range_proofs.rs:129-148` etc.) have no analogue of
+this because CPUs have no 100+ TOP/s matmul unit to feed.
+
+Method (Bajard-Plantard-style full-RNS Montgomery with a Shenoy-Kumaresan
+exact second extension):
+
+- Two bases A = {a_1..a_k}, B = {b_1..b_k} of distinct 16-bit primes with
+  2 channels of slack (A > (k+1)^2 * N), plus one redundant channel m_r.
+  Working domain: values < (k+1) * N, chain-stable.
+- MontMul(x, y) -> x*y*A^{-1} mod N (up to the domain bound):
+    d    = x .* y                 (elementwise, all channels)
+    xi   = d_A .* c1_A            (c1 folds -N^{-1} and (A/a_i)^{-1})
+    S1   = xi @ T1                (MXU; T1[i,j] = |A/a_i| mod (B, m_r))
+    q^   = S1 mod (B, m_r)        (fast extension: off by alpha*A <= k*A,
+                                   absorbed by the slack channels)
+    r    = (d + q^ .* N) .* A^{-1}   (in B and m_r)
+    zeta = r_B .* c2_B            (c2 = |(B/b_j)^{-1}| mod b_j)
+    S2   = zeta @ T2              (MXU; T2[j,i] = |B/b_j| mod (A, m_r))
+    beta = (S2_r - r_r) * |B|^{-1} mod m_r     (exact: beta < k < m_r)
+    r_A  = S2_A - beta * |B| mod A             (exact second extension)
+- 16-bit channel products fit uint32; channel reduction uses 2^16-fold
+  steps (primes are drawn downward from 2^16, so 2^16 mod m is small).
+- The matmuls run as four 8-bit-split bf16 dots with f32 accumulation:
+  products < 2^16, sums over <= 128-channel chunks < 2^23 — exact.
+- Host <-> device: big integers cross as 16-bit limb tensors (C-speed
+  bytes conversion); limbs -> residues is itself one matmul against
+  W[l, c] = 2^(16 l) mod m_c. Residues -> integer is a host CRT over A.
+
+Exponentiation is the same MSB-first 4-bit fixed window as the CIOS
+kernel, so wall-clock is ~1.27 RNS MontMuls per exponent bit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .limbs import LIMB_BITS, WINDOW_BITS, bucket_exp_bits, ints_to_limbs
+
+__all__ = ["RNSBases", "rns_modexp", "rns_bases_for_bits"]
+
+_U32 = jnp.uint32
+_LANE = 128  # matmul contraction chunk: k-slices of <= 128 keep f32 sums exact
+
+
+def _gen_channel_primes(count: int) -> List[int]:
+    """`count` distinct 16-bit primes, descending from 2^16 (keeps
+    2^16 mod m small, so channel reduction folds converge fast)."""
+    from ..core.primes import is_probable_prime
+
+    out = []
+    cand = (1 << 16) - 1
+    while len(out) < count and cand > (1 << 15):
+        if is_probable_prime(cand, rounds=16):
+            out.append(cand)
+        cand -= 2
+    if len(out) < count:
+        raise ValueError("not enough 16-bit primes for the requested base")
+    return out
+
+
+class RNSBases:
+    """Shared per-width-class constants: the channel primes, extension
+    matrices, and the limb->residue conversion matrix. Independent of the
+    batch's moduli (those enter per-launch as residue tensors)."""
+
+    def __init__(self, value_bits: int, num_limbs: int):
+        # Domain invariant: every chained value stays < (k+1)*N. With the
+        # fast (uncorrected) first extension this needs A > (k+1)^2 * N
+        # and B likewise; channel primes are < 2^16 and shrink as the list
+        # deepens, so k is grown until the bound holds with 2^16 margin.
+        self.value_bits = value_bits
+        self.num_limbs = num_limbs
+        k = -(-value_bits // 16) + 2
+        while True:
+            primes = _gen_channel_primes(2 * k + 1)
+            a_primes = primes[0::2][:k]
+            b_primes = primes[1::2][:k]
+            A = 1
+            for p in a_primes:
+                A *= p
+            B = 1
+            for p in b_primes:
+                B *= p
+            bound = (k + 1) * (k + 1) << (value_bits + 16)
+            if A > bound and B > bound:
+                break
+            k += 1
+        self.k = k
+        self.A_primes = a_primes
+        self.B_primes = b_primes
+        self.m_r = primes[2 * k]
+        self.A = A
+        self.B = B
+
+        A, B, m_r = self.A, self.B, self.m_r
+        aps, bps = self.A_primes, self.B_primes
+
+        Ai = [A // p for p in aps]
+        Bj = [B // p for p in bps]
+        # c-constant halves (the -N^{-1} factor joins per launch)
+        self.Ai_inv = np.array(
+            [pow(Ai[i] % aps[i], -1, aps[i]) for i in range(k)], np.uint32
+        )
+        self.c2_B = np.array(
+            [pow(Bj[j] % bps[j], -1, bps[j]) for j in range(k)], np.uint32
+        )
+        # extension matrices, target channels B+mr / A+mr
+        self.T1 = np.array(
+            [[Ai[i] % m for m in bps + [m_r]] for i in range(k)], np.uint32
+        )  # (k, k+1)
+        self.T2 = np.array(
+            [[Bj[j] % m for m in aps + [m_r]] for j in range(k)], np.uint32
+        )  # (k, k+1)
+        self.Ainv_B = np.array(
+            [pow(A % m, -1, m) for m in bps + [m_r]], np.uint32
+        )  # (k+1,) inverse of A in B channels and m_r
+        self.B_mod_A = np.array([B % m for m in aps], np.uint32)
+        self.Binv_r = np.uint32(pow(B % m_r, -1, m_r))
+
+        self.mA = np.array(aps, np.uint32)
+        self.mB = np.array(bps, np.uint32)
+        self.m_all = np.array(aps + bps + [m_r], np.uint32)  # (2k+1,)
+        # limb -> residue conversion matrix W[l, c] = 2^(16 l) mod m_c
+        self.Wconv = np.array(
+            [[pow(1 << (16 * l), 1, int(m)) for m in self.m_all]
+             for l in range(num_limbs)],
+            np.uint32,
+        )  # (num_limbs, 2k+1)
+
+    # -- host-side CRT (exit path) ---------------------------------------
+    def residues_to_int(self, xi_row: Sequence[int]) -> int:
+        """Exact value from A-channel *CRT coefficients* xi (already
+        multiplied by (A/a_i)^{-1} on device): v = sum xi_i * A/a_i mod A."""
+        acc = 0
+        A = self.A
+        for i, x in enumerate(xi_row):
+            acc += (A // self.A_primes[i]) * int(x)
+        return acc % A
+
+
+_BASES_CACHE: Dict[Tuple[int, int], RNSBases] = {}
+
+
+def rns_bases_for_bits(value_bits: int, num_limbs: int) -> RNSBases:
+    key = (value_bits, num_limbs)
+    if key not in _BASES_CACHE:
+        _BASES_CACHE[key] = RNSBases(value_bits, num_limbs)
+    return _BASES_CACHE[key]
+
+
+# ---------------------------------------------------------------------------
+# device kernels
+
+
+def _channel_mod(v, m, u16m, folds=6):
+    """v mod m per channel, v uint32 < 2^32, m a 16-bit prime close to
+    2^16, u16m = 2^16 mod m (<= 8536 for primes >= 57000). Each fold
+    maps v -> (v>>16)*u16m + (v&0xffff), shrinking the high part by
+    ~2^-3 per pass; six folds take a full 2^32-1 input below 3m in the
+    worst case (65535*8536 chain), which the two conditional subtracts
+    then finish. Callers with tighter input bounds pass a smaller
+    `folds`."""
+    for _ in range(folds):
+        v = (v >> 16) * u16m + (v & jnp.uint32(0xFFFF))
+    v = jnp.where(v >= m, v - m, v)
+    v = jnp.where(v >= m, v - m, v)
+    return v
+
+
+def _mulmod(a, b, m, u16m):
+    return _channel_mod(a * b, m, u16m)
+
+
+def _matmul_mod(x, T_splits, mods, u16m):
+    """x (R, k) uint32 16-bit values; T pre-split into bf16 lo/hi chunks;
+    returns (R, C) sums mod per-column modulus.
+
+    Each 8-bit-split product sum over a <=128 chunk is < 2^23, exact in
+    f32; chunk results add in uint32 (< 2^25 * chunks) and reduce by
+    channel folds."""
+    xl = (x & jnp.uint32(0xFF)).astype(jnp.bfloat16)
+    xh = (x >> 8).astype(jnp.bfloat16)
+    out = None
+    for lo, hi, start, size in T_splits:
+        xs_l = lax.dynamic_slice_in_dim(xl, start, size, axis=1)
+        xs_h = lax.dynamic_slice_in_dim(xh, start, size, axis=1)
+        pll = jax.lax.dot(xs_l, lo, precision=lax.Precision.HIGHEST,
+                          preferred_element_type=jnp.float32).astype(_U32)
+        plh = jax.lax.dot(xs_l, hi, precision=lax.Precision.HIGHEST,
+                          preferred_element_type=jnp.float32).astype(_U32)
+        phl = jax.lax.dot(xs_h, lo, precision=lax.Precision.HIGHEST,
+                          preferred_element_type=jnp.float32).astype(_U32)
+        phh = jax.lax.dot(xs_h, hi, precision=lax.Precision.HIGHEST,
+                          preferred_element_type=jnp.float32).astype(_U32)
+        # combine pll + 2^8(plh+phl) + 2^16 phh with interleaved folds;
+        # worst-case bound stays < 2^31 for <=128-term chunks and
+        # channel primes >= 57000 (u16m <= 8536)
+        lo16 = jnp.uint32(0xFFFF)
+        t1 = plh + phl  # < 2^24
+        t1 = (t1 >> 16) * u16m + (t1 & lo16)  # < 2^21.1
+        v = pll + (t1 << 8)  # < 2^29.2
+        t2 = (phh >> 16) * u16m + (phh & lo16)  # < 2^20.2
+        t2 = t2 << 8  # < 2^28.2
+        t2 = (t2 >> 16) * u16m + (t2 & lo16)  # < 2^25.3
+        t2 = (t2 >> 16) * u16m + (t2 & lo16)  # < 2^22.4
+        v = v + (t2 << 8)  # < 2^31
+        part = _channel_mod(v, mods, u16m, folds=6)
+        out = part if out is None else out + part
+    return _channel_mod(out, mods, u16m, folds=1)
+
+
+def _split_T(T: np.ndarray):
+    """Pre-split a constant uint32 matrix (k, C) into bf16 lo/hi chunks
+    along the contraction dim."""
+    k = T.shape[0]
+    out = []
+    for start in range(0, k, _LANE):
+        size = min(_LANE, k - start)
+        chunk = T[start : start + size]
+        out.append(
+            (
+                jnp.asarray((chunk & 0xFF).astype(np.float32), jnp.bfloat16),
+                jnp.asarray((chunk >> 8).astype(np.float32), jnp.bfloat16),
+                start,
+                size,
+            )
+        )
+    return out
+
+
+def _rns_mont_mul(x, y, consts):
+    """One RNS Montgomery product. x, y, out: (R, 2k+1) residues
+    (channels ordered A | B | m_r)."""
+    k = consts["k"]
+    m_all, u_all = consts["m_all"], consts["u_all"]
+    d = _mulmod(x, y, m_all, u_all)
+    d_A = d[:, :k]
+    xi = _mulmod(d_A, consts["c1_A"], m_all[:k], u_all[:k])
+    q = _matmul_mod(xi, consts["T1s"], m_all[k:], u_all[k:])  # (R, k+1) in B|mr
+    t = _mulmod(q, consts["N_Bmr"], m_all[k:], u_all[k:])
+    t = t + d[:, k:]
+    t = jnp.where(t >= m_all[k:], t - m_all[k:], t)
+    r_Bmr = _mulmod(t, consts["Ainv_B"], m_all[k:], u_all[k:])
+    zeta = _mulmod(r_Bmr[:, :k], consts["c2_B"], m_all[k : 2 * k], u_all[k : 2 * k])
+    s = _matmul_mod(zeta, consts["T2s"], consts["mA_mr"], consts["uA_mr"])  # (R, k+1) in A|mr
+    # exact Shenoy correction from the redundant channel
+    m_r, u_r = m_all[2 * k], u_all[2 * k]
+    diff = jnp.where(
+        s[:, k] >= r_Bmr[:, k], s[:, k] - r_Bmr[:, k], s[:, k] + m_r - r_Bmr[:, k]
+    )
+    beta = _mulmod(diff, consts["Binv_r"], m_r, u_r)  # (R,) < k
+    corr = _mulmod(
+        jnp.broadcast_to(beta[:, None], (x.shape[0], k)),
+        consts["B_mod_A"],
+        m_all[:k],
+        u_all[:k],
+    )
+    r_A = jnp.where(s[:, :k] >= corr, s[:, :k] - corr, s[:, :k] + m_all[:k] - corr)
+    return jnp.concatenate([r_A, r_Bmr], axis=1)
+
+
+def _limbs_to_residues(limbs, consts):
+    """(R, L) 16-bit limb rows -> (R, 2k+1) residues via the conversion
+    matmul."""
+    return _matmul_mod(limbs, consts["Ws"], consts["m_all"], consts["u_all"])
+
+
+@partial(jax.jit, static_argnames=("exp_bits", "k"))
+def _rns_modexp_kernel(
+    base_limbs, exp, a2n_limbs, c1_A, N_Bmr, consts_arrays, *, exp_bits, k
+):
+    """base^exp per row. All big values arrive as 16-bit limb tensors and
+    convert to residues on device. Returns the full residue rows (host
+    finishes with one CRT sum per row over the A channels)."""
+    (m_all, u_all, T1l, T1h, T2l, T2h, Ainv_B, c2_B, B_mod_A, Binv_r, Wl, Wh) = (
+        consts_arrays
+    )
+
+    def resplit(lo, hi):
+        ksz = lo.shape[0]
+        return [
+            (lo[s : s + _LANE], hi[s : s + _LANE], s, min(_LANE, ksz - s))
+            for s in range(0, ksz, _LANE)
+        ]
+
+    consts = dict(
+        k=k,
+        m_all=m_all,
+        u_all=u_all,
+        T1s=resplit(T1l, T1h),
+        T2s=resplit(T2l, T2h),
+        Ws=resplit(Wl, Wh),
+        mA_mr=jnp.concatenate([m_all[:k], m_all[2 * k :]]),
+        uA_mr=jnp.concatenate([u_all[:k], u_all[2 * k :]]),
+        Ainv_B=Ainv_B,
+        c2_B=c2_B,
+        B_mod_A=B_mod_A,
+        Binv_r=Binv_r,
+        c1_A=c1_A,
+        N_Bmr=N_Bmr,
+    )
+
+    base_res = _limbs_to_residues(base_limbs, consts)
+    a2n_res = _limbs_to_residues(a2n_limbs, consts)
+    one = jnp.ones_like(base_res)  # residues of 1 in every channel
+
+    # into the A-Montgomery domain: x*A = MontMul(x, A^2 mod N)
+    base_m = _rns_mont_mul(base_res, a2n_res, consts)
+    one_m = _rns_mont_mul(one, a2n_res, consts)  # A mod N residues
+
+    # 16-entry window table
+    def build(j, table):
+        prev = table[j - 1]
+        table = table.at[j].set(_rns_mont_mul(prev, base_m, consts))
+        return table
+
+    table0 = jnp.zeros((1 << WINDOW_BITS,) + base_m.shape, _U32)
+    table0 = table0.at[0].set(one_m).at[1].set(base_m)
+    table = lax.fori_loop(2, 1 << WINDOW_BITS, build, table0)
+
+    idx = jnp.arange(1 << WINDOW_BITS, dtype=_U32)[:, None, None]
+
+    def step(wi, acc):
+        shift = exp_bits - WINDOW_BITS * (wi + 1)
+        limb = lax.dynamic_index_in_dim(
+            exp, shift // LIMB_BITS, axis=1, keepdims=False
+        )
+        w = (limb >> (shift % LIMB_BITS)) & ((1 << WINDOW_BITS) - 1)
+        for _ in range(WINDOW_BITS):
+            acc = _rns_mont_mul(acc, acc, consts)
+        sel = jnp.sum(
+            jnp.where(w[None, :, None] == idx, table, jnp.uint32(0)), axis=0
+        )
+        return _rns_mont_mul(acc, sel, consts)
+
+    acc = lax.fori_loop(0, exp_bits // WINDOW_BITS, step, one_m)
+    return _rns_mont_mul(acc, one, consts)  # leave Montgomery domain
+
+
+def _prep_consts(bases: RNSBases):
+    """Device-ready shared constant arrays for the kernel."""
+    m_all = bases.m_all
+    u_all = ((1 << 16) % m_all.astype(np.uint64)).astype(np.uint32)
+    return (
+        jnp.asarray(m_all),
+        jnp.asarray(u_all),
+        jnp.asarray((bases.T1 & 0xFF).astype(np.float32), jnp.bfloat16),
+        jnp.asarray((bases.T1 >> 8).astype(np.float32), jnp.bfloat16),
+        jnp.asarray((bases.T2 & 0xFF).astype(np.float32), jnp.bfloat16),
+        jnp.asarray((bases.T2 >> 8).astype(np.float32), jnp.bfloat16),
+        jnp.asarray(bases.Ainv_B),
+        jnp.asarray(bases.c2_B),
+        jnp.asarray(bases.B_mod_A),
+        jnp.asarray(np.full((1,), bases.Binv_r, np.uint32)[0]),
+        jnp.asarray((bases.Wconv & 0xFF).astype(np.float32), jnp.bfloat16),
+        jnp.asarray((bases.Wconv >> 8).astype(np.float32), jnp.bfloat16),
+    )
+
+
+def rns_modexp(
+    bases_int: Sequence[int],
+    exps: Sequence[int],
+    moduli: Sequence[int],
+    value_bits: int,
+) -> List[int]:
+    """bases^exps mod moduli row-wise through the RNS/MXU pipeline."""
+    if not bases_int:
+        return []
+    rows = len(bases_int)
+    num_limbs = -(-value_bits // LIMB_BITS)
+    rb = rns_bases_for_bits(value_bits, num_limbs)
+    k = rb.k
+
+    exp_bits = bucket_exp_bits(exps)
+    el = -(-exp_bits // LIMB_BITS)
+
+    # per-row host precomputes (cheap bigint work). A modulus sharing a
+    # factor with a channel prime cannot ride the RNS pipeline (real
+    # Paillier/ring-Pedersen moduli are products of large primes, but a
+    # malicious party could craft one): those rows fall back to host pow
+    # and the row is neutralized in the launch.
+    a2n = [pow(rb.A, 2, n) for n in moduli]
+    c1 = np.zeros((rows, k), np.uint32)
+    n_bmr = np.zeros((rows, k + 1), np.uint32)
+    fallback_rows = {}
+    moduli = list(moduli)
+    bases_int = list(bases_int)
+    exps = list(exps)
+    for r, n in enumerate(moduli):
+        try:
+            for i, a in enumerate(rb.A_primes):
+                c1[r, i] = (-pow(n, -1, a)) % a * int(rb.Ai_inv[i]) % a
+            for j, b in enumerate(rb.B_primes):
+                n_bmr[r, j] = n % b
+            n_bmr[r, k] = n % rb.m_r
+        except ValueError:  # gcd(n, a_i) > 1: only the A channels need n invertible
+            fallback_rows[r] = pow(bases_int[r] % n, exps[r], n)
+            moduli[r], bases_int[r], exps[r] = 3, 1, 0
+            a2n[r] = pow(rb.A, 2, 3)
+            c1[r, :] = [
+                (-pow(3, -1, a)) % a * int(rb.Ai_inv[i]) % a
+                for i, a in enumerate(rb.A_primes)
+            ]
+            n_bmr[r, :k] = [3 % b for b in rb.B_primes]
+            n_bmr[r, k] = 3 % rb.m_r
+
+    out_res = _rns_modexp_kernel(
+        jnp.asarray(ints_to_limbs([b % n for b, n in zip(bases_int, moduli)], num_limbs)),
+        jnp.asarray(ints_to_limbs(list(exps), el)),
+        jnp.asarray(ints_to_limbs(a2n, num_limbs)),
+        jnp.asarray(c1),
+        jnp.asarray(n_bmr),
+        _prep_consts(rb),
+        exp_bits=exp_bits,
+        k=k,
+    )
+    res = np.asarray(out_res)
+
+    # host CRT exit: xi_i = |v_i * (A/a_i)^{-1}|_{a_i}, v = sum xi_i A/a_i mod A
+    out = []
+    Ai = [rb.A // p for p in rb.A_primes]
+    for r in range(rows):
+        if r in fallback_rows:
+            out.append(fallback_rows[r])
+            continue
+        acc = 0
+        for i, (p, inv) in enumerate(zip(rb.A_primes, rb.Ai_inv)):
+            xi = int(res[r, i]) * int(inv) % p
+            acc += Ai[i] * xi
+        out.append(acc % rb.A % moduli[r])
+    return out

@@ -107,16 +107,20 @@ class RefreshMessage:
 
         powm = get_batch_powm(config)
 
-        per = []  # per-sender working state, in input order
-        for old_party_index, local_key in senders:
+        # validate every sender BEFORE the first mutation: a late failure
+        # must not leave earlier senders' vss_scheme replaced by schemes
+        # whose shares were never broadcast
+        for _, local_key in senders:
             t = local_key.t
             if t > new_n // 2:
                 raise PartiesThresholdViolation(threshold=t, refreshed_keys=new_n)
             if new_n <= t:
                 raise NewPartyUnassignedIndexError()
 
+        per = []  # per-sender working state, in input order
+        for old_party_index, local_key in senders:
             scheme, secret_shares = vss.share(
-                t, new_n, local_key.keys_linear.x_i
+                local_key.t, new_n, local_key.keys_linear.x_i
             )
             local_key.vss_scheme = scheme
             receiver_eks = [local_key.paillier_key_vec[i] for i in range(new_n)]

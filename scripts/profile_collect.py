@@ -51,14 +51,16 @@ def main():
 
     import jax
 
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     try:
-        jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(repo_root, ".jax_cache")
+        )
     except Exception:
         pass
 
     from fsdkr_tpu.config import ProtocolConfig
     from fsdkr_tpu.backend import tpu_verifier
-    from fsdkr_tpu.backend.batch_verifier import BatchVerifier
     from fsdkr_tpu.protocol import RefreshMessage
 
     cfg = ProtocolConfig(paillier_bits=bits, m_security=m_sec, backend="tpu")
