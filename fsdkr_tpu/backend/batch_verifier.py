@@ -93,9 +93,33 @@ class HostBatchVerifier(BatchVerifier):
         return [scheme.validate_share_public(point, idx) for scheme, point, idx in items]
 
 
-def get_backend(config: ProtocolConfig = DEFAULT_CONFIG) -> BatchVerifier:
+class TracedVerifier:
+    """Wraps any backend with per-family phase timers/counters
+    (fsdkr_tpu.utils.trace) — the observability the reference lacks
+    entirely (SURVEY.md §5). Deliberately NOT a BatchVerifier subclass:
+    inherited abstract methods would shadow __getattr__ delegation."""
+
+    def __init__(self, inner: BatchVerifier):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name.startswith(("verify_", "validate_")) and callable(attr):
+            from ..utils.trace import phase
+
+            def traced(items, *args, _attr=attr, _name=name, **kwargs):
+                with phase(f"collect.{_name}", items=len(items)):
+                    return _attr(items, *args, **kwargs)
+
+            return traced
+        return attr
+
+
+def get_backend(config: ProtocolConfig = DEFAULT_CONFIG) -> "TracedVerifier":
+    """Returns the configured backend wrapped in a TracedVerifier (which
+    quacks like a BatchVerifier via delegation)."""
     if config.backend == "host":
-        return HostBatchVerifier()
+        return TracedVerifier(HostBatchVerifier())
     if config.backend == "tpu":
         try:
             from .tpu_verifier import TpuBatchVerifier
@@ -103,5 +127,5 @@ def get_backend(config: ProtocolConfig = DEFAULT_CONFIG) -> BatchVerifier:
             raise NotImplementedError(
                 "the TPU batch-verifier backend is unavailable in this build"
             ) from e
-        return TpuBatchVerifier(config)
+        return TracedVerifier(TpuBatchVerifier(config))
     raise ValueError(f"unknown backend {config.backend!r}")

@@ -69,6 +69,19 @@ def tpu_modmul(a, b, moduli) -> List[int]:
     return _cached_ctx(moduli, k).modmul(a, b)[:rows]
 
 
+# Generic-kernel routing: batches at least this wide take the RNS/MXU
+# pipeline (ops.rns) instead of the CIOS/VPU kernel. Measured crossover
+# on v5e is a few hundred rows; override with FSDKR_RNS_MIN_ROWS
+# (0 = always RNS, large = never).
+import os as _os
+
+_RNS_MIN_ROWS = int(_os.environ.get("FSDKR_RNS_MIN_ROWS", "512"))
+
+# modulus width classes with prepared RNS bases (caps distinct compiled
+# kernel shapes; moduli bucket up to the nearest class)
+_RNS_WIDTH_CLASSES = (256, 512, 1024, 1536, 2048, 3072, 4096)
+
+
 def tpu_powm(bases, exps, moduli) -> List[int]:
     from ..ops.limbs import limbs_for_bits
 
@@ -79,7 +92,16 @@ def tpu_powm(bases, exps, moduli) -> List[int]:
     bases = list(bases) + [1] * pad
     exps = list(exps) + [0] * pad
     moduli = list(moduli) + [3] * pad
-    k = limbs_for_bits(max(m.bit_length() for m in moduli))
+
+    width = max(m.bit_length() for m in moduli)
+    if b >= _RNS_MIN_ROWS:
+        for cls in _RNS_WIDTH_CLASSES:
+            if width <= cls:
+                from ..ops.rns import rns_modexp
+
+                return rns_modexp(bases, exps, moduli, cls)[:b]
+
+    k = limbs_for_bits(width)
     return _cached_ctx(moduli, k).modexp(bases, exps)[:b]
 
 

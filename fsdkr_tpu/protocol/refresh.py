@@ -139,13 +139,16 @@ class RefreshMessage:
                 )
             )
 
+        from ..utils.trace import phase
+
         # ---- fused encryption column over all (sender, receiver) pairs
-        flat_enc = paillier.encrypt_with_randomness_batch(
-            [ek for p in per for ek in p["eks"]],
-            [s.to_int() for p in per for s in p["shares"]],
-            [r for p in per for r in p["rand"]],
-            powm,
-        )
+        with phase("distribute.encrypt", items=len(per) * new_n):
+            flat_enc = paillier.encrypt_with_randomness_batch(
+                [ek for p in per for ek in p["eks"]],
+                [s.to_int() for p in per for s in p["shares"]],
+                [r for p in per for r in p["rand"]],
+                powm,
+            )
         for k, p in enumerate(per):
             p["enc"] = flat_enc[k * new_n : (k + 1) * new_n]
 
@@ -168,33 +171,41 @@ class RefreshMessage:
             for p in per
             for s, r in zip(p["shares"], p["rand"])
         ]
-        flat_pdl = PDLwSlackProof.prove_batch(flat_witnesses, flat_statements, powm)
+        with phase("distribute.pdl_prove", items=len(flat_witnesses)):
+            flat_pdl = PDLwSlackProof.prove_batch(
+                flat_witnesses, flat_statements, powm
+            )
 
-        flat_range = AliceProof.generate_batch(
-            [
-                (
-                    p["shares"][i].to_int(),
-                    p["enc"][i],
-                    p["eks"][i],
-                    p["key"].h1_h2_n_tilde_vec[i],
-                    p["rand"][i],
-                )
-                for p in per
-                for i in range(new_n)
-            ],
-            powm=powm,
-        )
+        with phase("distribute.range_prove", items=len(per) * new_n):
+            flat_range = AliceProof.generate_batch(
+                [
+                    (
+                        p["shares"][i].to_int(),
+                        p["enc"][i],
+                        p["eks"][i],
+                        p["key"].h1_h2_n_tilde_vec[i],
+                        p["rand"][i],
+                    )
+                    for p in per
+                    for i in range(new_n)
+                ],
+                powm=powm,
+            )
 
         # ---- per-sender keygens (host-serial, native Miller-Rabin) and
         # fused correct-key / ring-Pedersen prover columns
-        ek_dk = [paillier.keygen(config.paillier_bits) for _ in per]
-        ck_proofs = NiCorrectKeyProof.proof_batch(
-            [dk for _, dk in ek_dk], rounds=config.correct_key_rounds, powm=powm
-        )
-        rp = [RingPedersenStatement.generate(config) for _ in per]
-        rp_proofs = RingPedersenProof.prove_batch(
-            [w for _, w in rp], [st for st, _ in rp], config.m_security, powm
-        )
+        with phase("distribute.keygen", items=len(per)):
+            ek_dk = [paillier.keygen(config.paillier_bits) for _ in per]
+        with phase("distribute.ring_pedersen_gen", items=len(per)):
+            rp = [RingPedersenStatement.generate(config) for _ in per]
+        with phase("distribute.correct_key_prove", items=len(per)):
+            ck_proofs = NiCorrectKeyProof.proof_batch(
+                [dk for _, dk in ek_dk], rounds=config.correct_key_rounds, powm=powm
+            )
+        with phase("distribute.ring_pedersen_prove", items=len(per)):
+            rp_proofs = RingPedersenProof.prove_batch(
+                [w for _, w in rp], [st for st, _ in rp], config.m_security, powm
+            )
 
         out = []
         for k, p in enumerate(per):

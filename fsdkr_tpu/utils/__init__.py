@@ -1,0 +1,1 @@
+from .trace import Tracer, get_tracer, jax_profile, phase  # noqa: F401

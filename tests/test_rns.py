@@ -74,6 +74,20 @@ class TestModexp:
         (got,) = rns_modexp([3], [e], [n], bits)
         assert got == pow(3, e, n)
 
+    def test_tpu_powm_rns_routing(self, monkeypatch):
+        # force the generic-path router through the RNS pipeline and
+        # check the full hand-off: width-class bucketing, pow2 padding
+        # (modulus-3 dummy rows), result slicing
+        from fsdkr_tpu.backend import powm
+
+        monkeypatch.setattr(powm, "_RNS_MIN_ROWS", 1)
+        bits = 384
+        mods = [primes.gen_prime(192) * primes.gen_prime(192) for _ in range(3)]
+        bases = [random.getrandbits(bits) % n for n in mods]
+        exps = [random.getrandbits(bits) for _ in mods]
+        got = powm.tpu_powm(bases, exps, mods)
+        assert got == [pow(b, e, n) for b, e, n in zip(bases, exps, mods)]
+
     @pytest.mark.slow
     def test_full_size_2048(self):
         n = primes.gen_prime(1024) * primes.gen_prime(1024)

@@ -1,0 +1,61 @@
+"""Tests for the tracing/metrics subsystem (fsdkr_tpu.utils.trace) and its
+integration with the protocol hot paths."""
+
+from fsdkr_tpu.utils import Tracer, get_tracer
+
+
+class TestTracer:
+    def test_disabled_records_nothing(self):
+        tr = Tracer(enabled=False)
+        with tr.phase("x", items=5):
+            pass
+        assert tr.stats() == {}
+
+    def test_phase_accumulates(self):
+        tr = Tracer(enabled=True)
+        for _ in range(3):
+            with tr.phase("verify", items=10):
+                pass
+        st = tr.stats()["verify"]
+        assert st.calls == 3 and st.items == 30 and st.seconds >= 0
+
+    def test_phase_records_on_exception(self):
+        tr = Tracer(enabled=True)
+        try:
+            with tr.phase("boom", items=1):
+                raise RuntimeError
+        except RuntimeError:
+            pass
+        assert tr.stats()["boom"].calls == 1
+
+    def test_report_renders(self):
+        tr = Tracer(enabled=True)
+        with tr.phase("a", items=2):
+            pass
+        rep = tr.report()
+        assert "a" in rep and "items/s" in rep
+        assert Tracer(enabled=True).report() == "(no phases recorded)"
+
+
+class TestProtocolIntegration:
+    def test_refresh_stamps_phases(self, test_config):
+        from fsdkr_tpu.protocol import simulate_dkr, simulate_keygen
+
+        tracer = get_tracer()
+        tracer.reset()
+        tracer.enable()
+        try:
+            keys = simulate_keygen(1, 3, test_config)
+            simulate_dkr(keys, test_config)
+        finally:
+            tracer.disable()
+        stats = tracer.stats()
+        for expected in (
+            "distribute.encrypt",
+            "distribute.pdl_prove",
+            "collect.verify_pdl",
+            "collect.verify_ring_pedersen",
+            "collect.validate_feldman",
+        ):
+            assert expected in stats, (expected, sorted(stats))
+            assert stats[expected].items > 0
