@@ -125,7 +125,17 @@ def tpu_powm_shared(bases, exps_per_group, moduli) -> List[List[int]]:
     moduli = list(moduli) + [3] * (g_pad - g)
     exps = [list(e) + [0] * (m_pad - len(e)) for e in exps_per_group]
     exps += [[0] * m_pad] * (g_pad - g)
-    k = limbs_for_bits(max(m.bit_length() for m in moduli))
+
+    width = max(m.bit_length() for m in moduli)
+    if g_pad * m_pad >= _RNS_MIN_ROWS:
+        for cls in _RNS_WIDTH_CLASSES:
+            if width <= cls:
+                from ..ops.rns import rns_modexp_shared
+
+                out = rns_modexp_shared(bases, exps, moduli, cls)
+                return [out[i][: len(exps_per_group[i])] for i in range(g)]
+
+    k = limbs_for_bits(width)
     out = shared_base_modexp(bases, exps, moduli, k, ctx=_cached_ctx(moduli, k).ctx)
     return [out[i][: len(exps_per_group[i])] for i in range(g)]
 

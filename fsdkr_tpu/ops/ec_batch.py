@@ -130,25 +130,46 @@ def _identity_rows(b):
     return pt.at[:, 1, :].set(_bcast(_ONE_M, b))
 
 
+_EC_WINDOW = 4
+
+
 @partial(jax.jit, static_argnames=("scalar_bits",))
 def _scalar_mul_kernel(points, scalars, *, scalar_bits):
-    """points: (B, 3, K); scalars: (B, SL) limbs. MSB-first double-and-
-    always-add; the no-op add multiplies by the identity (complete
-    formula), so every iteration has identical shape and cost."""
+    """points: (B, 3, K); scalars: (B, SL) limbs. MSB-first 4-bit fixed
+    windows: a 16-entry multiples table (15 sequential adds), then per
+    window 4 doublings and one branchless table add — ~335 complete
+    additions for 256-bit scalars vs 512 for bit-at-a-time. The w=0 entry
+    is the identity (absorbed by the complete formula), so every window
+    costs the same."""
+    assert scalar_bits % _EC_WINDOW == 0
     b = points.shape[0]
     ident = _identity_rows(b)
 
-    def step(i, acc):
-        bit_idx = scalar_bits - 1 - i
+    def build(j, table):
+        table = table.at[j].set(_padd(table[j - 1], points))
+        return table
+
+    table0 = jnp.zeros((1 << _EC_WINDOW, b, 3, _K), _U32)
+    table0 = table0.at[0].set(ident).at[1].set(points)
+    table = lax.fori_loop(2, 1 << _EC_WINDOW, build, table0)
+
+    idx = jnp.arange(1 << _EC_WINDOW, dtype=_U32)[:, None, None, None]
+
+    def step(wi, acc):
+        shift = scalar_bits - _EC_WINDOW * (wi + 1)
         limb = lax.dynamic_index_in_dim(
-            scalars, bit_idx // LIMB_BITS, axis=1, keepdims=False
+            scalars, shift // LIMB_BITS, axis=1, keepdims=False
         )
-        bit = (limb >> (bit_idx % LIMB_BITS)) & 1  # (B,)
-        acc = _padd(acc, acc)
-        sel = jnp.where(bit[:, None, None].astype(bool), points, ident)
+        w = (limb >> (shift % LIMB_BITS)) & ((1 << _EC_WINDOW) - 1)  # (B,)
+        for _ in range(_EC_WINDOW):
+            acc = _padd(acc, acc)
+        sel = jnp.sum(
+            jnp.where(w[None, :, None, None] == idx, table, jnp.uint32(0)),
+            axis=0,
+        )
         return _padd(acc, sel)
 
-    return lax.fori_loop(0, scalar_bits, step, ident)
+    return lax.fori_loop(0, scalar_bits // _EC_WINDOW, step, ident)
 
 
 @jax.jit

@@ -374,6 +374,214 @@ def _prep_consts(bases: RNSBases):
     )
 
 
+@partial(jax.jit, static_argnames=("exp_bits", "k"))
+def _rns_shared_modexp_kernel(
+    powers_limbs, exp, a2n_limbs, c1_A, N_Bmr, consts_arrays, *, exp_bits, k
+):
+    """Fixed-base comb over RNS MontMuls: groups share (base, modulus).
+
+    powers_limbs: (W, G, L) limb rows of base^(16^w) mod n (host ladder);
+    exp: (G, M, EL); a2n_limbs: (G, L); c1_A: (G, k); N_Bmr: (G, k+1).
+    Same comb structure as ops.montgomery._shared_modexp_kernel — ladder
+    amortized per group, log-depth 16-entry tables, one table multiply
+    per window on the (G*M)-row batch — but every multiply is an RNS
+    MontMul whose base extensions ride the MXU. Returns (G*M, 2k+1)
+    residues for the host CRT exit.
+    """
+    (m_all, u_all, T1l, T1h, T2l, T2h, Ainv_B, c2_B, B_mod_A, Binv_r, Wl, Wh) = (
+        consts_arrays
+    )
+
+    def resplit(lo, hi):
+        ksz = lo.shape[0]
+        return [
+            (lo[s : s + _LANE], hi[s : s + _LANE], s, min(_LANE, ksz - s))
+            for s in range(0, ksz, _LANE)
+        ]
+
+    w_cnt, g, L = powers_limbs.shape
+    m = exp.shape[1]
+    c = 2 * k + 1
+
+    def consts_for(c1_rows, n_rows):
+        return dict(
+            k=k,
+            m_all=m_all,
+            u_all=u_all,
+            T1s=resplit(T1l, T1h),
+            T2s=resplit(T2l, T2h),
+            Ws=resplit(Wl, Wh),
+            mA_mr=jnp.concatenate([m_all[:k], m_all[2 * k :]]),
+            uA_mr=jnp.concatenate([u_all[:k], u_all[2 * k :]]),
+            Ainv_B=Ainv_B,
+            c2_B=c2_B,
+            B_mod_A=B_mod_A,
+            Binv_r=Binv_r,
+            c1_A=c1_rows,
+            N_Bmr=n_rows,
+        )
+
+    # group consts broadcast to the three batch layouts used below
+    consts_g = consts_for(c1_A, N_Bmr)
+    c1_wg = jnp.broadcast_to(c1_A[None], (w_cnt, g, k)).reshape(w_cnt * g, k)
+    n_wg = jnp.broadcast_to(N_Bmr[None], (w_cnt, g, k + 1)).reshape(w_cnt * g, k + 1)
+    consts_wg = consts_for(c1_wg, n_wg)
+    c1_gm = jnp.broadcast_to(c1_A[:, None], (g, m, k)).reshape(g * m, k)
+    n_gm = jnp.broadcast_to(N_Bmr[:, None], (g, m, k + 1)).reshape(g * m, k + 1)
+    consts_gm = consts_for(c1_gm, n_gm)
+
+    a2n_res = _limbs_to_residues(a2n_limbs, consts_g)  # (G, C)
+    a2n_wg = jnp.broadcast_to(a2n_res[None], (w_cnt, g, c)).reshape(w_cnt * g, c)
+    p_res = _limbs_to_residues(powers_limbs.reshape(w_cnt * g, L), consts_wg)
+    p1 = _rns_mont_mul(p_res, a2n_wg, consts_wg)  # Montgomery domain
+
+    one_g = jnp.ones((g, c), _U32)
+    one_m_g = _rns_mont_mul(one_g, a2n_res, consts_g)  # (G, C)
+    one_m_wg = jnp.broadcast_to(one_m_g[None], (w_cnt, g, c)).reshape(w_cnt * g, c)
+
+    def mul_many(pairs):
+        a = jnp.concatenate([x for x, _ in pairs], axis=0)
+        b = jnp.concatenate([y for _, y in pairs], axis=0)
+        cc = consts_for(
+            jnp.concatenate([c1_wg] * len(pairs), axis=0),
+            jnp.concatenate([n_wg] * len(pairs), axis=0),
+        )
+        out = _rns_mont_mul(a, b, cc)
+        return [
+            out[i * w_cnt * g : (i + 1) * w_cnt * g] for i in range(len(pairs))
+        ]
+
+    p2 = _rns_mont_mul(p1, p1, consts_wg)
+    p3, p4 = mul_many([(p2, p1), (p2, p2)])
+    p5, p6, p7, p8 = mul_many([(p4, p1), (p4, p2), (p4, p3), (p4, p4)])
+    p9, p10, p11, p12, p13, p14, p15 = mul_many(
+        [(p8, p1), (p8, p2), (p8, p3), (p8, p4), (p8, p5), (p8, p6), (p8, p7)]
+    )
+    table = jnp.stack(
+        [t.reshape(w_cnt, g, c) for t in
+         (one_m_wg, p1, p2, p3, p4, p5, p6, p7, p8, p9, p10, p11, p12, p13, p14, p15)],
+        axis=0,
+    )  # (16, W, G, C)
+
+    acc0 = jnp.broadcast_to(one_m_g[:, None], (g, m, c)).reshape(g * m, c)
+    idx = jnp.arange(1 << WINDOW_BITS, dtype=_U32)[:, None, None, None]
+
+    def acc_step(w, acc):
+        shift = WINDOW_BITS * w
+        limb = lax.dynamic_index_in_dim(
+            exp, shift // LIMB_BITS, axis=2, keepdims=False
+        )  # (G, M)
+        d = (limb >> (shift % LIMB_BITS)) & ((1 << WINDOW_BITS) - 1)
+        entries = lax.dynamic_index_in_dim(table, w, axis=1, keepdims=False)
+        sel = jnp.sum(
+            jnp.where(
+                d[None, :, :, None] == idx, entries[:, :, None, :], jnp.uint32(0)
+            ),
+            axis=0,
+        )
+        return _rns_mont_mul(acc, sel.reshape(g * m, c), consts_gm)
+
+    acc = lax.fori_loop(0, exp_bits // WINDOW_BITS, acc_step, acc0)
+    one_rows = jnp.ones((g * m, c), _U32)
+    return _rns_mont_mul(acc, one_rows, consts_gm)
+
+
+def rns_modexp_shared(
+    bases_int: Sequence[int],
+    exps_per_group: Sequence[Sequence[int]],
+    moduli: Sequence[int],
+    value_bits: int,
+) -> List[List[int]]:
+    """Fixed-base comb through the RNS/MXU pipeline:
+    bases[g]^exps[g][m] mod moduli[g]. The per-group power ladder runs on
+    the host (one pow(p, 16, n) chain per group); rows pad with exponent
+    0. Moduli sharing a factor with a channel prime fall back per group."""
+    g_cnt = len(bases_int)
+    if g_cnt == 0:
+        return []
+    num_limbs = -(-value_bits // LIMB_BITS)
+    rb = rns_bases_for_bits(value_bits, num_limbs)
+    k = rb.k
+    m_max = max(len(e) for e in exps_per_group)
+    exp_bits = bucket_exp_bits([e for grp in exps_per_group for e in grp])
+    el = -(-exp_bits // LIMB_BITS)
+    w_cnt = exp_bits // WINDOW_BITS
+
+    bases_int = [b % n for b, n in zip(bases_int, moduli)]
+    a2n = []
+    c1 = np.zeros((g_cnt, k), np.uint32)
+    n_bmr = np.zeros((g_cnt, k + 1), np.uint32)
+    fallback_groups = {}
+    moduli = list(moduli)
+    work_bases = list(bases_int)
+    for r, n in enumerate(moduli):
+        try:
+            row = [
+                (-pow(n, -1, a)) % a * int(rb.Ai_inv[i]) % a
+                for i, a in enumerate(rb.A_primes)
+            ]
+        except ValueError:
+            fallback_groups[r] = [
+                pow(bases_int[r], e, n) for e in exps_per_group[r]
+            ]
+            moduli[r], work_bases[r] = 3, 1
+            row = [
+                (-pow(3, -1, a)) % a * int(rb.Ai_inv[i]) % a
+                for i, a in enumerate(rb.A_primes)
+            ]
+        c1[r, :] = row
+        n_bmr[r, :k] = [moduli[r] % b for b in rb.B_primes]
+        n_bmr[r, k] = moduli[r] % rb.m_r
+        a2n.append(pow(rb.A, 2, moduli[r]))
+
+    # host power ladder, Montgomery-free (plain residue inputs; the kernel
+    # converts and enters the Montgomery domain itself)
+    flat_powers: List[int] = []
+    for b, n in zip(work_bases, moduli):
+        p = b % n
+        for _ in range(w_cnt):
+            flat_powers.append(p)
+            p = pow(p, 1 << WINDOW_BITS, n)
+    powers_limbs = (
+        ints_to_limbs(flat_powers, num_limbs)
+        .reshape(g_cnt, w_cnt, num_limbs)
+        .transpose(1, 0, 2)
+    )
+
+    flat_exps: List[int] = []
+    for grp in exps_per_group:
+        flat_exps.extend(list(grp) + [0] * (m_max - len(grp)))
+    exp_limbs = ints_to_limbs(flat_exps, el).reshape(g_cnt, m_max, el)
+
+    out_res = _rns_shared_modexp_kernel(
+        jnp.asarray(powers_limbs),
+        jnp.asarray(exp_limbs),
+        jnp.asarray(ints_to_limbs(a2n, num_limbs)),
+        jnp.asarray(c1),
+        jnp.asarray(n_bmr),
+        _prep_consts(rb),
+        exp_bits=exp_bits,
+        k=k,
+    )
+    res = np.asarray(out_res).reshape(g_cnt, m_max, 2 * k + 1)
+
+    out: List[List[int]] = []
+    Ai = [rb.A // p for p in rb.A_primes]
+    for r in range(g_cnt):
+        if r in fallback_groups:
+            out.append(fallback_groups[r])
+            continue
+        grp_out = []
+        for mi in range(len(exps_per_group[r])):
+            acc = 0
+            for i, (p, inv) in enumerate(zip(rb.A_primes, rb.Ai_inv)):
+                xi = int(res[r, mi, i]) * int(inv) % p
+                acc += Ai[i] * xi
+            grp_out.append(acc % rb.A % moduli[r])
+        out.append(grp_out)
+    return out
+
+
 def rns_modexp(
     bases_int: Sequence[int],
     exps: Sequence[int],
