@@ -1,18 +1,22 @@
 #!/usr/bin/env python
 """North-star benchmark (BASELINE.json): RefreshMessage.collect wall-clock,
 reported as proofs verified per second, TPU batch backend vs the host
-(pure-Python) baseline on the identical workload.
+(native C++ Montgomery) baseline on the identical workload.
 
 Prints exactly ONE JSON line to stdout:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
-All progress goes to stderr.
+On any failure (including TPU backend init) the line still appears, with
+an "error" field and value 0. All progress goes to stderr.
 
 Default workload: a real full-size refresh (2048-bit Paillier, M=256
 ring-Pedersen, 11 correct-key rounds) at committee n=16, t=8 — one
 collecting party verifies 2*n^2 PDL+range proofs, n ring-Pedersen and n
 correct-key proofs (plus n^2 Feldman EC checks). `vs_baseline` is the
-speedup of the TPU backend over the host backend (host measured on a
-subsample, extrapolated linearly — it is a serial per-proof loop).
+speedup of the TPU backend over the host backend routed through the
+native C++ Montgomery core (the repo's best CPU path — see
+fsdkr_tpu/core/intops.py mod_pow); the CPython-only number is reported
+separately as `vs_cpython` / stderr. Host cost is measured on a
+subsample of >= 25% of the n^2 pair loop and extrapolated linearly.
 
 Environment knobs: BENCH_N / BENCH_T / BENCH_BITS / BENCH_M override the
 workload for experiments; defaults match BASELINE.md.
@@ -28,13 +32,18 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def main():
-    n = int(os.environ.get("BENCH_N", "16"))
-    t = int(os.environ.get("BENCH_T", "8"))
-    bits = int(os.environ.get("BENCH_BITS", "2048"))
-    m_sec = int(os.environ.get("BENCH_M", "256"))
+def emit(result):
+    print(json.dumps(result), flush=True)
 
-    # persistent compilation cache: repeat bench runs skip XLA compiles
+
+def _metric(n, t, bits):
+    return f"collect() proof verification throughput @ n={n},t={t},{bits}-bit"
+
+
+def init_jax_with_retry(attempts=4, delay=15.0):
+    """TPU backend init is flaky on this platform (round-1 bench died on
+    it; round-3 first probe hung). Retry with backoff; raise only after
+    all attempts fail."""
     import jax
 
     try:
@@ -42,13 +51,33 @@ def main():
     except Exception:
         pass
 
+    last = None
+    for i in range(attempts):
+        try:
+            devs = jax.devices()
+            log(f"devices: {devs}")
+            return jax, devs
+        except Exception as e:  # backend init failure is retriable
+            last = e
+            log(f"jax.devices() attempt {i + 1}/{attempts} failed: {e}")
+            time.sleep(delay)
+    raise RuntimeError(f"TPU backend unavailable after {attempts} attempts: {last}")
+
+
+def main():
+    n = int(os.environ.get("BENCH_N", "16"))
+    t = int(os.environ.get("BENCH_T", "8"))
+    bits = int(os.environ.get("BENCH_BITS", "2048"))
+    m_sec = int(os.environ.get("BENCH_M", "256"))
+
+    jax, _ = init_jax_with_retry()
+
     from fsdkr_tpu.config import ProtocolConfig
     from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
 
     cfg = ProtocolConfig(paillier_bits=bits, m_security=m_sec)
     tpu_cfg = cfg.with_backend("tpu")
 
-    log(f"devices: {jax.devices()}")
     log(f"setup: keygen + distribute, n={n} t={t} bits={bits} M={m_sec} ...")
     t0 = time.time()
     keys = simulate_keygen(t, n, cfg)
@@ -80,16 +109,27 @@ def main():
     log(f"tpu collect warm: {t_tpu:.2f}s -> {proofs / t_tpu:.1f} proofs/s")
 
     # --- host baseline on a subsample (serial loop; linear extrapolation)
+    # Two baselines: the native C++ Montgomery path (intops.mod_pow routes
+    # wide odd-modulus pow through csrc/fsdkr_native.cpp — this is the
+    # denominator of vs_baseline) and pure CPython (FSDKR_NATIVE_POW=0,
+    # reported as vs_cpython for comparability with earlier rounds).
+    from fsdkr_tpu import native
     from fsdkr_tpu.backend.batch_verifier import HostBatchVerifier
+    from fsdkr_tpu.core import intops
     from fsdkr_tpu.core.secp256k1 import GENERATOR
     from fsdkr_tpu.proofs.pdl_slack import PDLwSlackStatement
 
+    log(f"native core available: {native.available()}")
+
     host = HostBatchVerifier()
-    key = keys[2]
-    sample = max(4, n // 2)
+    key = keys[2 % n]
+    # >= 25% of the n^2 (sender, receiver) pair loop
+    pair_target = max(8, (n * n) // 4)
     pdl_items, range_items = [], []
-    for msg in msgs[:2]:
-        for i in range(sample // 2):
+    for msg in msgs:
+        for i in range(n):
+            if len(pdl_items) >= pair_target:
+                break
             st = PDLwSlackStatement(
                 ciphertext=msg.points_encrypted_vec[i],
                 ek=key.paillier_key_vec[i],
@@ -108,36 +148,81 @@ def main():
                     key.h1_h2_n_tilde_vec[i],
                 )
             )
+        if len(pdl_items) >= pair_target:
+            break
 
-    t0 = time.time()
-    assert all(v is None for v in host.verify_pdl(pdl_items))
-    assert all(host.verify_range(range_items))
-    per_pair = (time.time() - t0) / len(pdl_items)
+    rp_sample = msgs[: max(2, n // 4)]
+    rp_items = [(m.ring_pedersen_proof, m.ring_pedersen_statement) for m in rp_sample]
+    ck_items = [(m.dk_correctness_proof, m.ek) for m in rp_sample]
 
-    rp_items = [(m.ring_pedersen_proof, m.ring_pedersen_statement) for m in msgs[:2]]
-    t0 = time.time()
-    assert all(host.verify_ring_pedersen(rp_items, m_sec))
-    per_rp = (time.time() - t0) / len(rp_items)
+    def measure_host(tag):
+        t0 = time.time()
+        ok_pdl = all(v is None for v in host.verify_pdl(pdl_items))
+        ok_range = all(host.verify_range(range_items))
+        per_pair = (time.time() - t0) / len(pdl_items)
 
-    ck_items = [(m.dk_correctness_proof, m.ek) for m in msgs[:2]]
-    t0 = time.time()
-    assert all(host.verify_correct_key(ck_items, cfg.correct_key_rounds))
-    per_ck = (time.time() - t0) / len(ck_items)
+        t0 = time.time()
+        ok_rp = all(host.verify_ring_pedersen(rp_items, m_sec))
+        per_rp = (time.time() - t0) / len(rp_items)
 
-    t_host = n * n * per_pair + n * per_rp + n * per_ck
-    log(
-        f"host baseline (extrapolated from {len(pdl_items)} pairs): "
-        f"{t_host:.2f}s -> {proofs / t_host:.1f} proofs/s"
-    )
+        t0 = time.time()
+        ok_ck = all(host.verify_correct_key(ck_items, cfg.correct_key_rounds))
+        per_ck = (time.time() - t0) / len(ck_items)
+        if not (ok_pdl and ok_range and ok_rp and ok_ck):
+            raise RuntimeError(f"host[{tag}] baseline rejected a valid proof")
+
+        total = n * n * per_pair + n * per_rp + n * per_ck
+        log(
+            f"host[{tag}] baseline (extrapolated from {len(pdl_items)} of "
+            f"{n * n} pairs, {len(rp_items)} of {n} rp/ck): "
+            f"{total:.2f}s -> {proofs / total:.1f} proofs/s"
+        )
+        return total
+
+    t_host_native = measure_host("native-c++")
+
+    intops._native_modexp = False  # force CPython pow
+    try:
+        t_host_py = measure_host("cpython")
+    finally:
+        intops._native_modexp = None  # restore autodetect
 
     result = {
-        "metric": f"collect() proof verification throughput @ n={n},t={t},{bits}-bit",
+        "metric": _metric(n, t, bits),
         "value": round(proofs / t_tpu, 2),
         "unit": "proofs/s",
-        "vs_baseline": round(t_host / t_tpu, 2),
+        "vs_baseline": round(t_host_native / t_tpu, 2),
+        "vs_cpython": round(t_host_py / t_tpu, 2),
+        # vs_baseline is only "vs native C++" when the core actually loaded;
+        # otherwise both baselines are CPython and this flags it
+        "host_native_available": native.available(),
+        "collect_warm_s": round(t_tpu, 2),
+        "collect_cold_s": round(t_tpu_cold, 2),
+        "distribute_batch_s": round(t_distribute, 2),
     }
-    print(json.dumps(result), flush=True)
+    emit(result)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # always leave a JSON line for the driver
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        try:
+            n = int(os.environ.get("BENCH_N", "16"))
+            t = int(os.environ.get("BENCH_T", "8"))
+            bits = int(os.environ.get("BENCH_BITS", "2048"))
+        except ValueError:
+            n, t, bits = 16, 8, 2048
+        emit(
+            {
+                "metric": _metric(n, t, bits),
+                "value": 0,
+                "unit": "proofs/s",
+                "vs_baseline": 0,
+                "error": f"{type(e).__name__}: {e}",
+            }
+        )
+        sys.exit(0)

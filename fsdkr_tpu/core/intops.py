@@ -12,6 +12,7 @@ against these functions.
 from __future__ import annotations
 
 import math
+import os
 import secrets
 
 __all__ = [
@@ -30,8 +31,36 @@ __all__ = [
 ]
 
 
+# Wide odd-modulus exponentiation routes through the native C++ Montgomery
+# core (csrc/fsdkr_native.cpp) so that "host backend" means the repo's best
+# CPU path, not CPython pow — this is the baseline the TPU backend is
+# benchmarked against. FSDKR_NATIVE_POW=0 restores pure CPython (the
+# independent oracle used when differential-testing the native core itself).
+_NATIVE_POW_MIN_BITS = 1024  # below this, ctypes overhead beats the win
+_native_modexp = None
+
+
+def _get_native_modexp():
+    global _native_modexp
+    if _native_modexp is None:
+        if os.environ.get("FSDKR_NATIVE_POW", "1") != "1":
+            _native_modexp = False
+        else:
+            try:
+                from .. import native
+
+                _native_modexp = native.modexp if native.available() else False
+            except Exception:
+                _native_modexp = False
+    return _native_modexp
+
+
 def mod_pow(base: int, exp: int, modulus: int) -> int:
     """base^exp mod modulus for exp >= 0."""
+    if exp >= 0 and modulus & 1 and modulus.bit_length() >= _NATIVE_POW_MIN_BITS:
+        impl = _get_native_modexp()
+        if impl:
+            return impl(base, exp, modulus)
     return pow(base, exp, modulus)
 
 
@@ -45,8 +74,8 @@ def mod_pow_signed(base: int, exp: int, modulus: int) -> int:
         inv = mod_inv(base, modulus)
         if inv is None:
             raise ValueError("base not invertible for negative exponent")
-        return pow(inv, -exp, modulus)
-    return pow(base, exp, modulus)
+        return mod_pow(inv, -exp, modulus)
+    return mod_pow(base, exp, modulus)
 
 
 def mod_inv(x: int, modulus: int):
@@ -106,3 +135,17 @@ def from_bytes(b: bytes) -> int:
 
 def gcd(a: int, b: int) -> int:
     return math.gcd(a, b)
+
+
+def zeroize_ints(*lists) -> None:
+    """Drop proof-nonce references as soon as the proof is assembled
+    (reference zeroizes its ZKP round state,
+    `/root/reference/src/range_proofs.rs:28-29,222-243`).
+
+    Python ints are immutable, so the values cannot be overwritten in
+    place; clearing the containers releases the only references so the
+    values become collectable immediately instead of surviving in live
+    round-state objects. See README "Security notes" for the limits of
+    this relative to Rust's zeroize."""
+    for lst in lists:
+        lst.clear()

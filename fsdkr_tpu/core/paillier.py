@@ -77,7 +77,7 @@ def encrypt_with_randomness(ek: EncryptionKey, m: int, r: int) -> int:
     if r <= 0 or math.gcd(r, ek.n) != 1:
         raise ValueError("Paillier randomness must be a unit of Z_n")
     gm = (1 + (m % ek.n) * ek.n) % ek.nn
-    return (gm * pow(r, ek.n, ek.nn)) % ek.nn
+    return (gm * intops.mod_pow(r, ek.n, ek.nn)) % ek.nn
 
 
 def encrypt_with_randomness_batch(eks, ms, rs, powm=None) -> list:
@@ -85,7 +85,9 @@ def encrypt_with_randomness_batch(eks, ms, rs, powm=None) -> list:
     (the per-receiver encryption fan-out of distribute,
     `/root/reference/src/refresh_message.rs:72-84`)."""
     if powm is None:
-        powm = lambda b, e, mod: [pow(x, y, z) for x, y, z in zip(b, e, mod)]
+        powm = lambda b, e, mod: [
+            intops.mod_pow(x, y, z) for x, y, z in zip(b, e, mod)
+        ]
     if not (len(eks) == len(ms) == len(rs)):
         raise ValueError(
             f"batch length mismatch: {len(eks)} keys, {len(ms)} plaintexts, "
@@ -116,8 +118,8 @@ def decrypt(dk: DecryptionKey, ek: EncryptionKey, c: int) -> int:
     # correction factor is h_p = ((p-1)*q)^{-1} mod p (and symmetrically q).
     hp = pow((p - 1) * q % p, -1, p)
     hq = pow((q - 1) * p % q, -1, q)
-    mp = ((pow(c % pp, p - 1, pp) - 1) // p) * hp % p
-    mq = ((pow(c % qq, q - 1, qq) - 1) // q) * hq % q
+    mp = ((intops.mod_pow(c % pp, p - 1, pp) - 1) // p) * hp % p
+    mq = ((intops.mod_pow(c % qq, q - 1, qq) - 1) // q) * hq % q
     # CRT combine
     qinv = pow(q, -1, p)
     diff = (mp - mq) * qinv % p
@@ -131,4 +133,4 @@ def add(ek: EncryptionKey, c1: int, c2: int) -> int:
 
 def mul(ek: EncryptionKey, c: int, k: int) -> int:
     """Homomorphic scalar multiplication: Enc(m) (*) k = c^k mod n^2."""
-    return pow(c, k % ek.n, ek.nn)
+    return intops.mod_pow(c, k % ek.n, ek.nn)

@@ -13,9 +13,12 @@ implementation when the toolchain is unavailable.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
+import platform
 import secrets
 import subprocess
+import tempfile
 import threading
 from typing import List, Optional, Sequence
 
@@ -29,29 +32,55 @@ __all__ = [
 _LIMB_BYTES = 8
 _MAX_LIMBS = 64  # 4096 bits, keep in sync with MAXL in csrc
 _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc", "fsdkr_native.cpp")
-_SO = os.path.join(os.path.dirname(__file__), "_fsdkr_native.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 _lock = threading.Lock()
 
 
+def _so_path(src: str) -> str:
+    """Cache path tagged by source hash + machine arch: a stale or
+    cross-arch artifact (e.g. copied checkout, -march=native on a
+    different host) can never be picked up."""
+    with open(src, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(
+        os.path.dirname(__file__), f"_fsdkr_native_{tag}_{platform.machine()}.so"
+    )
+
+
 def _build() -> Optional[ctypes.CDLL]:
     src = os.path.abspath(_SRC)
     if not os.path.exists(src):
         return None
-    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(src):
-        cmd = [
-            "g++", "-O3", "-march=native", "-shared", "-fPIC",
-            "-o", _SO + ".tmp", src,
-        ]
+    so = _so_path(src)
+    if not os.path.exists(so):
+        fd, tmp = tempfile.mkstemp(
+            suffix=".so", prefix="_fsdkr_build_", dir=os.path.dirname(so)
+        )
+        os.close(fd)
+        cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", tmp, src]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            os.replace(_SO + ".tmp", _SO)
+            os.replace(tmp, so)
         except (subprocess.SubprocessError, OSError):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
             return None
+        # prune artifacts from older source revisions / other arches
+        here = os.path.dirname(so)
+        for name in os.listdir(here):
+            if name.startswith("_fsdkr_native") and name.endswith(".so"):
+                path = os.path.join(here, name)
+                if path != so:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
     try:
-        lib = ctypes.CDLL(_SO)
+        lib = ctypes.CDLL(so)
     except OSError:
         return None
     lib.fsdkr_modexp.restype = ctypes.c_int

@@ -106,7 +106,7 @@ class AliceProof:
             for (a, cipher, ek, d, r), n, zi, ui, wi in zip(items, nv, z, u, w)
         ]
         re_ = powm([r for *_, r in items], e, nv)
-        return [
+        proofs = [
             AliceProof(
                 z=zi,
                 e=ei,
@@ -118,6 +118,8 @@ class AliceProof:
                 items, nv, z, e, re_, beta, alpha, rho, gamma
             )
         ]
+        intops.zeroize_ints(alpha, beta, rho, gamma)
+        return proofs
 
     def verify(
         self,
@@ -133,15 +135,20 @@ class AliceProof:
         if self.s1 > q**3 or self.s1 < 0:
             return False
 
-        z_e_inv = intops.mod_inv(pow(self.z, self.e, n_tilde), n_tilde)
+        z_e_inv = intops.mod_inv(intops.mod_pow(self.z, self.e, n_tilde), n_tilde)
         if z_e_inv is None:
             return False
-        w = pow(h1, self.s1, n_tilde) * pow(h2, self.s2, n_tilde) * z_e_inv % n_tilde
+        w = (
+            intops.mod_pow(h1, self.s1, n_tilde)
+            * intops.mod_pow(h2, self.s2, n_tilde)
+            * z_e_inv
+            % n_tilde
+        )
 
-        cipher_e_inv = intops.mod_inv(pow(cipher, self.e, nn), nn)
+        cipher_e_inv = intops.mod_inv(intops.mod_pow(cipher, self.e, nn), nn)
         if cipher_e_inv is None:
             return False
         gs1 = (1 + self.s1 * n) % nn
-        u = gs1 * pow(self.s, n, nn) * cipher_e_inv % nn
+        u = gs1 * intops.mod_pow(self.s, n, nn) * cipher_e_inv % nn
 
         return _challenge(n, cipher, self.z, u, w) == self.e

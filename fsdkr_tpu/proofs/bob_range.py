@@ -99,14 +99,14 @@ class BobProof:
         sigma = secrets.randbelow(q * n_tilde)
         tau = secrets.randbelow(q**3 * n_tilde)
 
-        z = pow(h1, b_int, n_tilde) * pow(h2, rho, n_tilde) % n_tilde
-        z_prim = pow(h1, alpha, n_tilde) * pow(h2, rho_prim, n_tilde) % n_tilde
-        t = pow(h1, beta_prim, n_tilde) * pow(h2, sigma, n_tilde) % n_tilde
-        w = pow(h1, gamma, n_tilde) * pow(h2, tau, n_tilde) % n_tilde
+        z = intops.mod_pow(h1, b_int, n_tilde) * intops.mod_pow(h2, rho, n_tilde) % n_tilde
+        z_prim = intops.mod_pow(h1, alpha, n_tilde) * intops.mod_pow(h2, rho_prim, n_tilde) % n_tilde
+        t = intops.mod_pow(h1, beta_prim, n_tilde) * intops.mod_pow(h2, sigma, n_tilde) % n_tilde
+        w = intops.mod_pow(h1, gamma, n_tilde) * intops.mod_pow(h2, tau, n_tilde) % n_tilde
         v = (
-            pow(a_encrypted, alpha, nn)
+            intops.mod_pow(a_encrypted, alpha, nn)
             * ((1 + gamma * n) % nn)
-            * pow(beta, n, nn)
+            * intops.mod_pow(beta, n, nn)
             % nn
         )
 
@@ -120,19 +120,21 @@ class BobProof:
         e = _challenge(n, a_encrypted, mta_encrypted, z, z_prim, t, v, w, check_pair)
 
         # round 2 (reference :313-336)
-        return (
-            BobProof(
-                t=t,
-                z=z,
-                e=e,
-                s=pow(r, e, n) * beta % n,
-                s1=e * b_int + alpha,
-                s2=e * rho + rho_prim,
-                t1=e * beta_prim + gamma,
-                t2=e * sigma + tau,
-            ),
-            u_point,
+        proof = BobProof(
+            t=t,
+            z=z,
+            e=e,
+            s=intops.mod_pow(r, e, n) * beta % n,
+            s1=e * b_int + alpha,
+            s2=e * rho + rho_prim,
+            t1=e * beta_prim + gamma,
+            t2=e * sigma + tau,
         )
+        # round-1 nonces (alpha..tau) die with this frame on return — the
+        # reference zeroizes BobZkpRound1 explicitly (range_proofs.rs:222-243)
+        # because its round structs outlive the round; here they never
+        # escape the prover call
+        return proof, u_point
 
     def verify(
         self,
@@ -149,26 +151,26 @@ class BobProof:
         if self.s1 > q**3 or self.s1 < 0:
             return False
 
-        z_e_inv = intops.mod_inv(pow(self.z, self.e, n_tilde), n_tilde)
+        z_e_inv = intops.mod_inv(intops.mod_pow(self.z, self.e, n_tilde), n_tilde)
         if z_e_inv is None:
             return False
-        z_prim = pow(h1, self.s1, n_tilde) * pow(h2, self.s2, n_tilde) * z_e_inv % n_tilde
+        z_prim = intops.mod_pow(h1, self.s1, n_tilde) * intops.mod_pow(h2, self.s2, n_tilde) * z_e_inv % n_tilde
 
-        mta_e_inv = intops.mod_inv(pow(mta_avc_out, self.e, nn), nn)
+        mta_e_inv = intops.mod_inv(intops.mod_pow(mta_avc_out, self.e, nn), nn)
         if mta_e_inv is None:
             return False
         v = (
-            pow(a_enc, self.s1, nn)
-            * pow(self.s, n, nn)
+            intops.mod_pow(a_enc, self.s1, nn)
+            * intops.mod_pow(self.s, n, nn)
             * ((1 + self.t1 * n) % nn)
             * mta_e_inv
             % nn
         )
 
-        t_e_inv = intops.mod_inv(pow(self.t, self.e, n_tilde), n_tilde)
+        t_e_inv = intops.mod_inv(intops.mod_pow(self.t, self.e, n_tilde), n_tilde)
         if t_e_inv is None:
             return False
-        w = pow(h1, self.t1, n_tilde) * pow(h2, self.t2, n_tilde) * t_e_inv % n_tilde
+        w = intops.mod_pow(h1, self.t1, n_tilde) * intops.mod_pow(h2, self.t2, n_tilde) * t_e_inv % n_tilde
 
         return _challenge(n, a_enc, mta_avc_out, self.z, z_prim, self.t, v, w, check) == self.e
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 import secrets
 from dataclasses import dataclass
 
+from ..core import intops
 from ..core.transcript import Transcript
 
 __all__ = ["DLogStatement", "CompositeDLogProof", "STAT_BITS"]
@@ -59,7 +60,7 @@ class CompositeDLogProof:
     @staticmethod
     def prove(st: DLogStatement, secret_x: int) -> "CompositeDLogProof":
         r = secrets.randbelow(st.N << STAT_BITS)
-        x_commit = pow(st.g, r, st.N)
+        x_commit = intops.mod_pow(st.g, r, st.N)
         e = CompositeDLogProof._challenge(x_commit, st)
         return CompositeDLogProof(x_commit=x_commit, y=r + e * secret_x)
 
@@ -67,5 +68,5 @@ class CompositeDLogProof:
         if not (0 < self.x_commit < st.N) or self.y < 0:
             return False
         e = CompositeDLogProof._challenge(self.x_commit, st)
-        lhs = pow(st.g, self.y, st.N) * pow(st.ni, e, st.N) % st.N
+        lhs = intops.mod_pow(st.g, self.y, st.N) * intops.mod_pow(st.ni, e, st.N) % st.N
         return lhs == self.x_commit

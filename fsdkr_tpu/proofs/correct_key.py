@@ -23,6 +23,7 @@ from dataclasses import dataclass
 from typing import List
 
 from ..config import DEFAULT_CONFIG
+from ..core import intops
 from ..core.paillier import DecryptionKey, EncryptionKey
 from ..core.primes import _PRIMORIAL
 from ..core.transcript import Transcript
@@ -107,6 +108,6 @@ class NiCorrectKeyProof:
         for i, sigma in enumerate(self.sigma_vec):
             if not (0 < sigma < n):
                 return False
-            if pow(sigma, n, n) != _derive_rho(n, salt, i):
+            if intops.mod_pow(sigma, n, n) != _derive_rho(n, salt, i):
                 return False
         return True

@@ -155,7 +155,7 @@ class PDLwSlackProof:
             for st, zi, u1i, u2i, u3i in zip(statements, z, u1, u2, u3)
         ]
         re_ = powm([w.r for w in witnesses], e, nv)
-        return [
+        proofs = [
             PDLwSlackProof(
                 z=zi,
                 u1=u1i,
@@ -169,6 +169,8 @@ class PDLwSlackProof:
                 witnesses, nv, z, u1, u2, u3, e, re_, beta, alpha, rho, gamma
             )
         ]
+        intops.zeroize_ints(alpha, beta, rho, gamma)
+        return proofs
 
     def verify(self, st: PDLwSlackStatement) -> None:
         """Raises PDLwSlackProofError with per-equation booleans on failure

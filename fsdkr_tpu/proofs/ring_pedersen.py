@@ -19,7 +19,7 @@ from dataclasses import dataclass
 from typing import List
 
 from ..config import ProtocolConfig, DEFAULT_CONFIG
-from ..core import primes
+from ..core import intops, primes
 from ..core.paillier import EncryptionKey
 from ..core.transcript import Transcript, challenge_bits
 from ..errors import RingPedersenProofError
@@ -119,6 +119,7 @@ class RingPedersenProof:
                 for a_i, b in zip(a_vec, bits)
             ]
             out.append(RingPedersenProof(A=A_vec, Z=Z_vec))
+        intops.zeroize_ints(*a_all)  # drop the commitment nonces
         return out
 
     def verify(
@@ -133,7 +134,7 @@ class RingPedersenProof:
         e = RingPedersenProof._challenge(self.A)
         bits = challenge_bits(e, m_security)
         for a_i, z_i, b in zip(self.A, self.Z, bits):
-            lhs = pow(st.T, z_i, st.N)
+            lhs = intops.mod_pow(st.T, z_i, st.N)
             rhs = a_i * (st.S if b else 1) % st.N
             if lhs != rhs:
                 raise RingPedersenProofError()

@@ -38,7 +38,7 @@ def generate_h1_h2_n_tilde(
         xhi_inv = intops.mod_inv(xhi, phi)
         if xhi_inv is not None:
             break
-    h2 = pow(h1, xhi, n_tilde)
+    h2 = intops.mod_pow(h1, xhi, n_tilde)
     return n_tilde, h1, h2, phi - xhi, phi - xhi_inv
 
 

@@ -27,6 +27,12 @@ import pytest  # noqa: E402
 from fsdkr_tpu.config import TEST_CONFIG  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: full-size security parameters; excluded from quick runs"
+    )
+
+
 @pytest.fixture(scope="session")
 def test_config():
     """Reduced-size parameters (768-bit Paillier, M=32) so the single-core
