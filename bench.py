@@ -19,7 +19,12 @@ separately as `vs_cpython` / stderr. Host cost is measured on a
 subsample of >= 25% of the n^2 pair loop and extrapolated linearly.
 
 Environment knobs: BENCH_N / BENCH_T / BENCH_BITS / BENCH_M override the
-workload for experiments; defaults match BASELINE.md.
+workload for experiments; defaults match BASELINE.md. BENCH_SESSIONS > 1
+switches to the multi-session config (BASELINE.json config 5): S
+independent (n, t) refresh sessions collected through ONE fused launch
+set per proof family (RefreshMessage.collect_sessions), stacked on the
+same batch axis and sharded over BENCH_MESH devices when set
+(e.g. BENCH_SESSIONS=64 BENCH_MESH=8 on a v5e-8).
 """
 
 import json
@@ -64,16 +69,89 @@ def init_jax_with_retry(attempts=4, delay=15.0):
     raise RuntimeError(f"TPU backend unavailable after {attempts} attempts: {last}")
 
 
+def bench_sessions(sessions_count, n, t, bits, m_sec):
+    """Config-5 shape: S independent (n, t) sessions, one fused collect
+    launch set (RefreshMessage.collect_sessions)."""
+    import dataclasses
+
+    from fsdkr_tpu.config import ProtocolConfig
+    from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+
+    cfg = ProtocolConfig(paillier_bits=bits, m_security=m_sec)
+    mesh_env = os.environ.get("BENCH_MESH")
+    mesh_shape = (int(mesh_env),) if mesh_env else None
+    tpu_cfg = dataclasses.replace(cfg, backend="tpu", mesh_shape=mesh_shape)
+
+    log(
+        f"multi-session setup: {sessions_count} sessions of n={n} t={t} "
+        f"bits={bits} M={m_sec} mesh={mesh_shape} ..."
+    )
+    t0 = time.time()
+    built = []
+    for _ in range(sessions_count):
+        keys = simulate_keygen(t, n, cfg)
+        results = RefreshMessage.distribute_batch(
+            [(key.i, key) for key in keys], n, tpu_cfg
+        )
+        built.append(
+            (keys, [m for m, _ in results], [dk for _, dk in results])
+        )
+    log(f"setup done in {time.time() - t0:.1f}s")
+
+    proofs_per_session = 2 * n * n + 2 * n
+
+    def run():
+        sessions = [
+            (msgs, keys[0].clone(), dks[0], ()) for keys, msgs, dks in built
+        ]
+        t0 = time.time()
+        errs = RefreshMessage.collect_sessions(sessions, tpu_cfg)
+        dt = time.time() - t0
+        bad = [i for i, e in enumerate(errs) if e is not None]
+        if bad:
+            raise RuntimeError(f"sessions failed: {bad}: {errs[bad[0]]}")
+        return dt
+
+    t_cold = run()
+    log(f"fused collect_sessions cold: {t_cold:.2f}s")
+    t_warm = run()
+    total_proofs = proofs_per_session * sessions_count
+    log(
+        f"fused collect_sessions warm: {t_warm:.2f}s -> "
+        f"{total_proofs / t_warm:.1f} proofs/s"
+    )
+    emit(
+        {
+            "metric": (
+                f"fused collect of {sessions_count} sessions @ n={n},t={t},"
+                f"{bits}-bit (config 5)"
+            ),
+            "value": round(total_proofs / t_warm, 2),
+            "unit": "proofs/s",
+            "vs_baseline": 0,
+            "collect_warm_s": round(t_warm, 2),
+            "collect_cold_s": round(t_cold, 2),
+            "sessions": sessions_count,
+            "mesh": mesh_shape,
+        }
+    )
+
+
 def main():
     n = int(os.environ.get("BENCH_N", "16"))
     t = int(os.environ.get("BENCH_T", "8"))
     bits = int(os.environ.get("BENCH_BITS", "2048"))
     m_sec = int(os.environ.get("BENCH_M", "256"))
+    sessions_count = int(os.environ.get("BENCH_SESSIONS", "1"))
 
     jax, _ = init_jax_with_retry()
 
     from fsdkr_tpu.config import ProtocolConfig
     from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+
+    if sessions_count > 1:
+        bench_sessions(sessions_count, n, t, bits, m_sec)
+        return
 
     cfg = ProtocolConfig(paillier_bits=bits, m_security=m_sec)
     tpu_cfg = cfg.with_backend("tpu")

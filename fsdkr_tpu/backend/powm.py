@@ -18,10 +18,38 @@ from ..config import ProtocolConfig, DEFAULT_CONFIG
 
 BatchPowm = Callable[[Sequence[int], Sequence[int], Sequence[int]], List[int]]
 
-# Montgomery contexts keyed by (moduli, limb count): a refresh reuses the
-# same modulus vectors across many launches (fused prover columns, beta^n,
-# r^e, verifier equations), so the per-row host precompute (n', R^2 mod N)
-# and the modulus tensor upload are paid once per vector, not per launch.
+# Active device mesh for sharded launches. The protocol entry points
+# (get_batch_powm on the prover side, TpuBatchVerifier on the verifier
+# side) install the mesh described by config.mesh_shape; None means
+# single-device execution (the JAX default placement). Process-wide by
+# design: a collect()/distribute() call configures it on entry.
+_MESH = None
+
+
+def apply_mesh(config: "ProtocolConfig") -> None:
+    """Install (or clear) the device mesh described by config.mesh_shape."""
+    global _MESH
+    if config.backend != "tpu" or config.mesh_shape is None:
+        _MESH = None
+        return
+    from ..parallel.mesh import make_mesh
+
+    shape = tuple(config.mesh_shape)
+    if _MESH is None or _MESH.devices.shape != shape:
+        _MESH = make_mesh(
+            shape, tuple(f"batch{i}" if i else "batch" for i in range(len(shape)))
+        )
+
+
+def active_mesh():
+    return _MESH
+
+
+# Montgomery contexts keyed by (moduli, limb count, mesh): a refresh reuses
+# the same modulus vectors across many launches (fused prover columns,
+# beta^n, r^e, verifier equations), so the per-row host precompute
+# (n', R^2 mod N) and the modulus tensor upload are paid once per vector,
+# not per launch.
 _CTX_CACHE: dict = {}
 _CTX_CACHE_MAX = 64
 
@@ -29,20 +57,27 @@ _CTX_CACHE_MAX = 64
 def _cached_ctx(moduli, num_limbs):
     from ..ops.montgomery import BatchModExp
 
-    key = (hash(tuple(moduli)), num_limbs)
+    key = (hash(tuple(moduli)), num_limbs, id(_MESH))
     ctx = _CTX_CACHE.get(key)
     if ctx is None or ctx.ctx.moduli != list(moduli):
         if len(_CTX_CACHE) >= _CTX_CACHE_MAX:
             _CTX_CACHE.clear()
-        ctx = BatchModExp(moduli, num_limbs)
+        ctx = BatchModExp(moduli, num_limbs, mesh=_MESH)
         _CTX_CACHE[key] = ctx
     return ctx
 
 
 def _pad_pow2(rows: int) -> int:
     """Pad batch sizes to powers of two (>= 8) so kernel shapes — and
-    therefore XLA compilations — are reused across calls and rounds."""
-    return max(8, 1 << (rows - 1).bit_length())
+    therefore XLA compilations — are reused across calls and rounds. With
+    a mesh active, additionally round up to a multiple of the device count
+    so rows split evenly."""
+    p = max(8, 1 << (rows - 1).bit_length())
+    if _MESH is not None:
+        from ..parallel.shard_kernels import padded_rows
+
+        p = padded_rows(p, _MESH)
+    return p
 
 
 def host_powm(bases, exps, moduli) -> List[int]:
@@ -99,7 +134,7 @@ def tpu_powm(bases, exps, moduli) -> List[int]:
             if width <= cls:
                 from ..ops.rns import rns_modexp
 
-                return rns_modexp(bases, exps, moduli, cls)[:b]
+                return rns_modexp(bases, exps, moduli, cls, mesh=_MESH)[:b]
 
     k = limbs_for_bits(width)
     return _cached_ctx(moduli, k).modexp(bases, exps)[:b]
@@ -119,6 +154,10 @@ def tpu_powm_shared(bases, exps_per_group, moduli) -> List[List[int]]:
         return []
     g = len(bases)
     g_pad = max(2, 1 << (g - 1).bit_length())
+    if _MESH is not None:
+        from ..parallel.shard_kernels import padded_rows
+
+        g_pad = padded_rows(g_pad, _MESH)
     m_max = max((len(e) for e in exps_per_group), default=1) or 1
     m_pad = max(8, 1 << (m_max - 1).bit_length())
     bases = list(bases) + [1] * (g_pad - g)
@@ -132,11 +171,13 @@ def tpu_powm_shared(bases, exps_per_group, moduli) -> List[List[int]]:
             if width <= cls:
                 from ..ops.rns import rns_modexp_shared
 
-                out = rns_modexp_shared(bases, exps, moduli, cls)
+                out = rns_modexp_shared(bases, exps, moduli, cls, mesh=_MESH)
                 return [out[i][: len(exps_per_group[i])] for i in range(g)]
 
     k = limbs_for_bits(width)
-    out = shared_base_modexp(bases, exps, moduli, k, ctx=_cached_ctx(moduli, k).ctx)
+    out = shared_base_modexp(
+        bases, exps, moduli, k, ctx=_cached_ctx(moduli, k).ctx, mesh=_MESH
+    )
     return [out[i][: len(exps_per_group[i])] for i in range(g)]
 
 
@@ -182,6 +223,7 @@ def tpu_powm_grouped(bases, exps, moduli) -> List[int]:
 
 
 def get_batch_powm(config: ProtocolConfig = DEFAULT_CONFIG) -> BatchPowm:
+    apply_mesh(config)
     return tpu_powm_grouped if config.backend == "tpu" else host_powm
 
 

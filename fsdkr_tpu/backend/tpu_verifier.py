@@ -71,6 +71,11 @@ class TpuBatchVerifier(BatchVerifier):
     def __init__(self, config: ProtocolConfig = DEFAULT_CONFIG):
         self.config = config
         self._host = HostBatchVerifier()
+        # install the device mesh described by config.mesh_shape: every
+        # modexp/modmul launch below row-shards over it (backend.powm)
+        from .powm import apply_mesh
+
+        apply_mesh(config)
 
     # ------------------------------------------------------------------
     def verify_pdl(self, items):

@@ -294,40 +294,67 @@ class BatchModExp:
     mesh is layered on in fsdkr_tpu.parallel.
     """
 
-    def __init__(self, moduli: Sequence[int], num_limbs: int):
+    def __init__(self, moduli: Sequence[int], num_limbs: int, mesh=None):
         self.ctx = MontgomeryContext(moduli, num_limbs)
+        self.mesh = mesh  # optional jax.sharding.Mesh: rows shard over it
         self._n = jnp.asarray(self.ctx.n)
         self._n_prime = jnp.asarray(self.ctx.n_prime)
         self._r2 = jnp.asarray(self.ctx.r2)
         self._one_mont = jnp.asarray(self.ctx.one_mont)
+
+    def _mesh_for_rows(self, rows: int):
+        if self.mesh is not None and rows % int(self.mesh.devices.size) == 0:
+            return self.mesh
+        return None
 
     def modexp(self, bases: Sequence[int], exps: Sequence[int]) -> List[int]:
         k = self.ctx.num_limbs
         bases = [b % n for b, n in zip(bases, self.ctx.moduli)]
         exp_bits = bucket_exp_bits(exps)
         exp_limbs = ints_to_limbs(exps, -(-exp_bits // LIMB_BITS))
-        out = _modexp_kernel(
-            jnp.asarray(ints_to_limbs(bases, k)),
-            jnp.asarray(exp_limbs),
-            self._n,
-            self._n_prime,
-            self._r2,
-            self._one_mont,
-            exp_bits=exp_bits,
-        )
+        mesh = self._mesh_for_rows(len(bases))
+        if mesh is not None:
+            from ..parallel.shard_kernels import sharded_modexp_fn
+
+            kernel = sharded_modexp_fn(mesh, exp_bits)
+            out = kernel(
+                jnp.asarray(ints_to_limbs(bases, k)),
+                jnp.asarray(exp_limbs),
+                self._n,
+                self._n_prime,
+                self._r2,
+                self._one_mont,
+            )
+        else:
+            out = _modexp_kernel(
+                jnp.asarray(ints_to_limbs(bases, k)),
+                jnp.asarray(exp_limbs),
+                self._n,
+                self._n_prime,
+                self._r2,
+                self._one_mont,
+                exp_bits=exp_bits,
+            )
         return limbs_to_ints(np.asarray(out))
 
     def modmul(self, a: Sequence[int], b: Sequence[int]) -> List[int]:
         k = self.ctx.num_limbs
         a = [x % n for x, n in zip(a, self.ctx.moduli)]
         b = [x % n for x, n in zip(b, self.ctx.moduli)]
-        out = _modmul_kernel(
+        args = (
             jnp.asarray(ints_to_limbs(a, k)),
             jnp.asarray(ints_to_limbs(b, k)),
             self._n,
             self._n_prime,
             self._r2,
         )
+        mesh = self._mesh_for_rows(len(a))
+        if mesh is not None:
+            from ..parallel.shard_kernels import sharded_modmul_fn
+
+            out = sharded_modmul_fn(mesh)(*args)
+        else:
+            out = _modmul_kernel(*args)
         return limbs_to_ints(np.asarray(out))
 
 
@@ -345,6 +372,7 @@ def shared_base_modexp(
     num_limbs: int,
     host_ladder: bool | None = None,
     ctx: MontgomeryContext | None = None,
+    mesh=None,
 ) -> List[List[int]]:
     """bases[g]^exps_per_group[g][m] mod moduli[g] via the fixed-base comb.
 
@@ -384,16 +412,21 @@ def shared_base_modexp(
             .transpose(1, 0, 2)
         )
 
-    out = _shared_modexp_kernel(
+    args = (
         jnp.asarray(ints_to_limbs([b % n for b, n in zip(bases, ctx.moduli)], num_limbs)),
         jnp.asarray(exp_limbs),
         jnp.asarray(ctx.n),
         jnp.asarray(ctx.n_prime),
         jnp.asarray(ctx.r2),
         jnp.asarray(ctx.one_mont),
-        powers,
-        exp_bits=exp_bits,
     )
+    if mesh is not None and g_cnt % int(mesh.devices.size) == 0:
+        from ..parallel.shard_kernels import sharded_shared_modexp_fn
+
+        kernel = sharded_shared_modexp_fn(mesh, exp_bits, powers is not None)
+        out = kernel(*args, powers) if powers is not None else kernel(*args)
+    else:
+        out = _shared_modexp_kernel(*args, powers, exp_bits=exp_bits)
     flat = limbs_to_ints(np.asarray(out).reshape(g_cnt * m_max, num_limbs))
     return [
         flat[g * m_max : g * m_max + len(exps_per_group[g])] for g in range(g_cnt)

@@ -1,0 +1,342 @@
+"""Pallas TPU kernels for RNS Montgomery arithmetic (SURVEY.md §7's
+"Pallas kernels for every hot numeric path", hard part 1).
+
+Two kernels:
+
+- `rns_mont_mul_pallas`: one RNS Montgomery product fused into a single
+  launch. The XLA expression (`ops.rns._rns_mont_mul`) is a chain of ~20
+  elementwise passes around two small matmuls; between fused regions XLA
+  materializes (R, 2k+1) uint32 intermediates to HBM, and at 2048 bits a
+  single modexp runs ~2560 such products — HBM traffic, not MXU time,
+  bounds the pipeline. Here the whole product for a row tile runs inside
+  VMEM: the only HBM traffic per product is x, y in and r out.
+
+- `rns_modexp_pallas`: the ENTIRE windowed exponentiation in one launch.
+  The 16-entry window table and the accumulator live in VMEM scratch for
+  the whole ~E/4-window loop, so HBM sees only the inputs once and the
+  result once — the kernel-fusion endgame of the north-star plan
+  (BASELINE.json). Per row tile: 2 + 14 table + 5*E/4 MontMuls, each
+  two MXU base-extension matmuls.
+
+The matmuls run as 8-bit-split bf16 dots with f32 accumulation; with
+k <= 257 channels a full-width dot stays exact (255^2 * 257 < 2^24), so
+no chunking is needed inside a tile.
+
+Numerics are IDENTICAL to `_rns_mont_mul` (same fold bounds, same
+Shenoy correction); `tests/test_pallas.py` pins the kernels against the
+XLA chain and against CPython pow. Interpret mode (`interpret=True`)
+runs the same kernels on CPU for the test suite; the real target is the
+MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .limbs import LIMB_BITS, WINDOW_BITS
+
+_U32 = jnp.uint32
+
+
+def _fold(v, u16m):
+    return (v >> 16) * u16m + (v & jnp.uint32(0xFFFF))
+
+
+def _channel_mod(v, m, u16m, folds=6):
+    for _ in range(folds):
+        v = _fold(v, u16m)
+    v = jnp.where(v >= m, v - m, v)
+    v = jnp.where(v >= m, v - m, v)
+    return v
+
+
+def _mulmod(a, b, m, u16m):
+    return _channel_mod(a * b, m, u16m)
+
+
+def _matmul_mod(x, lo, hi, mods, u16m):
+    """x (R, k) uint32 16-bit values, T pre-split bf16 (k, C): returns
+    (R, C) sums mod per-column modulus. Single full-width dot per split —
+    exact for k <= 257 (see module docstring)."""
+    xl = (x & jnp.uint32(0xFF)).astype(jnp.bfloat16)
+    xh = (x >> 8).astype(jnp.bfloat16)
+    dot = functools.partial(
+        jnp.dot,
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    pll = dot(xl, lo).astype(_U32)
+    plh = dot(xl, hi).astype(_U32)
+    phl = dot(xh, lo).astype(_U32)
+    phh = dot(xh, hi).astype(_U32)
+    # combine pll + 2^8(plh+phl) + 2^16 phh with interleaved folds; all
+    # intermediates stay < 2^31.2 for k <= 257 channels (u16m <= 8536)
+    t1 = _fold(plh + phl, u16m)
+    v = pll + (t1 << 8)
+    t2 = _fold(phh, u16m) << 8
+    t2 = _fold(_fold(t2, u16m), u16m)
+    v = v + (t2 << 8)
+    return _channel_mod(v, mods, u16m, folds=6)
+
+
+def _mont_mul_body(x, y, c1, nbmr, consts, k):
+    """The RNS Montgomery product on in-register/VMEM values.
+
+    x, y: (R, 2k+1) residues (channels A | B | m_r); c1: (R, k);
+    nbmr: (R, k+1); consts: dict of shared (1, ...) arrays.
+    """
+    m_all, u_all = consts["m_all"], consts["u_all"]
+    mA, uA = m_all[:, :k], u_all[:, :k]
+    mB_r, uB_r = m_all[:, k:], u_all[:, k:]
+    mB, uB = m_all[:, k : 2 * k], u_all[:, k : 2 * k]
+
+    d = _mulmod(x, y, m_all, u_all)
+    xi = _mulmod(d[:, :k], c1, mA, uA)
+    q = _matmul_mod(xi, consts["T1l"], consts["T1h"], mB_r, uB_r)  # (R, k+1)
+    t = _mulmod(q, nbmr, mB_r, uB_r) + d[:, k:]
+    t = jnp.where(t >= mB_r, t - mB_r, t)
+    r_Bmr = _mulmod(t, consts["Ainv_B"], mB_r, uB_r)
+    zeta = _mulmod(r_Bmr[:, :k], consts["c2_B"], mB, uB)
+    mA_mr = jnp.concatenate([mA, m_all[:, 2 * k :]], axis=1)
+    uA_mr = jnp.concatenate([uA, u_all[:, 2 * k :]], axis=1)
+    s = _matmul_mod(zeta, consts["T2l"], consts["T2h"], mA_mr, uA_mr)  # (R, k+1)
+    # exact Shenoy correction from the redundant channel (2-D slices —
+    # TPU vector lanes want rank >= 2)
+    m_r = m_all[:, 2 * k :]  # (1, 1)
+    u_r = u_all[:, 2 * k :]
+    s_r, r_r = s[:, k : k + 1], r_Bmr[:, k : k + 1]  # (R, 1)
+    diff = jnp.where(s_r >= r_r, s_r - r_r, s_r + m_r - r_r)
+    beta = _mulmod(diff, consts["Binv_r"], m_r, u_r)  # (R, 1), < k
+    corr = _mulmod(beta, consts["B_mod_A"], mA, uA)
+    r_A = jnp.where(s[:, :k] >= corr, s[:, :k] - corr, s[:, :k] + mA - corr)
+    return jnp.concatenate([r_A, r_Bmr], axis=1)
+
+
+def _mont_mul_kernel(
+    x_ref,
+    y_ref,
+    c1_ref,
+    nbmr_ref,
+    mall_ref,
+    uall_ref,
+    T1l_ref,
+    T1h_ref,
+    T2l_ref,
+    T2h_ref,
+    ainv_ref,
+    c2_ref,
+    bmoda_ref,
+    binvr_ref,
+    out_ref,
+    *,
+    k,
+):
+    consts = dict(
+        m_all=mall_ref[:],
+        u_all=uall_ref[:],
+        T1l=T1l_ref[:],
+        T1h=T1h_ref[:],
+        T2l=T2l_ref[:],
+        T2h=T2h_ref[:],
+        Ainv_B=ainv_ref[:],
+        c2_B=c2_ref[:],
+        B_mod_A=bmoda_ref[:],
+        Binv_r=binvr_ref[:],
+    )
+    out_ref[:] = _mont_mul_body(
+        x_ref[:], y_ref[:], c1_ref[:], nbmr_ref[:], consts, k
+    )
+
+
+def _row_tile(rows: int, cap: int = 256) -> int:
+    """Largest power-of-two divisor of `rows`, capped (VMEM budget)."""
+    t = rows & -rows  # lowest set bit
+    return min(t, cap) if t else 1
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "interpret", "tile")
+)
+def rns_mont_mul_pallas(
+    x, y, c1, nbmr, shared, *, k, interpret=False, tile=None
+):
+    """One RNS Montgomery product as a single fused Pallas launch.
+
+    x, y: (R, 2k+1) uint32 residues; c1: (R, k); nbmr: (R, k+1);
+    shared: tuple (m_all, u_all, T1l, T1h, T2l, T2h, Ainv_B, c2_B,
+    B_mod_A, Binv_r) with 1-D entries shaped (1, ...) by the caller.
+    R must be divisible by the row tile (callers pad to powers of two).
+    """
+    rows, C = x.shape
+    t = tile or _row_tile(rows)
+    grid = (rows // t,)
+
+    def row_spec(width):
+        return pl.BlockSpec((t, width), lambda i: (i, 0))
+
+    def const_spec(a):
+        return pl.BlockSpec(a.shape, lambda i: (0,) * a.ndim)
+
+    (m_all, u_all, T1l, T1h, T2l, T2h, ainv, c2, bmoda, binvr) = shared
+    kernel = functools.partial(_mont_mul_kernel, k=k)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, C), jnp.uint32),
+        grid=grid,
+        in_specs=[
+            row_spec(C),  # x
+            row_spec(C),  # y
+            row_spec(k),  # c1
+            row_spec(k + 1),  # nbmr
+            const_spec(m_all),
+            const_spec(u_all),
+            const_spec(T1l),
+            const_spec(T1h),
+            const_spec(T2l),
+            const_spec(T2h),
+            const_spec(ainv),
+            const_spec(c2),
+            const_spec(bmoda),
+            const_spec(binvr),
+        ],
+        out_specs=row_spec(C),
+        interpret=interpret,
+    )(x, y, c1, nbmr, m_all, u_all, T1l, T1h, T2l, T2h, ainv, c2, bmoda, binvr)
+
+
+# ---------------------------------------------------------------------------
+# full windowed modexp in one launch
+
+
+def _modexp_kernel_pallas(
+    base_ref,
+    exp_ref,
+    a2n_ref,
+    c1_ref,
+    nbmr_ref,
+    mall_ref,
+    uall_ref,
+    T1l_ref,
+    T1h_ref,
+    T2l_ref,
+    T2h_ref,
+    ainv_ref,
+    c2_ref,
+    bmoda_ref,
+    binvr_ref,
+    out_ref,
+    table_ref,
+    *,
+    k,
+    exp_bits,
+):
+    consts = dict(
+        m_all=mall_ref[:],
+        u_all=uall_ref[:],
+        T1l=T1l_ref[:],
+        T1h=T1h_ref[:],
+        T2l=T2l_ref[:],
+        T2h=T2h_ref[:],
+        Ainv_B=ainv_ref[:],
+        c2_B=c2_ref[:],
+        B_mod_A=bmoda_ref[:],
+        Binv_r=binvr_ref[:],
+    )
+    c1 = c1_ref[:]
+    nbmr = nbmr_ref[:]
+
+    def mul(a, b):
+        return _mont_mul_body(a, b, c1, nbmr, consts, k)
+
+    a2n = a2n_ref[:]
+    one = jnp.ones_like(a2n)
+    base_m = mul(base_ref[:], a2n)  # into the A-Montgomery domain
+    one_m = mul(one, a2n)
+
+    # 16-entry window table in VMEM scratch (static unroll: 14 products)
+    table_ref[0] = one_m
+    table_ref[1] = base_m
+    prev = base_m
+    for j in range(2, 1 << WINDOW_BITS):
+        prev = mul(prev, base_m)
+        table_ref[j] = prev
+
+    idx = jax.lax.broadcasted_iota(
+        _U32, (1 << WINDOW_BITS, 1, 1), dimension=0
+    )
+
+    def step(wi, acc):
+        shift = exp_bits - WINDOW_BITS * (wi + 1)
+        limb = exp_ref[:, pl.ds(shift // LIMB_BITS, 1)]  # (R, 1)
+        w = (limb >> (shift % LIMB_BITS)) & jnp.uint32((1 << WINDOW_BITS) - 1)
+        for _ in range(WINDOW_BITS):
+            acc = mul(acc, acc)
+        sel = jnp.sum(
+            jnp.where(w[None, :, :] == idx, table_ref[:], jnp.uint32(0)),
+            axis=0,
+        )
+        return mul(acc, sel)
+
+    acc = jax.lax.fori_loop(0, exp_bits // WINDOW_BITS, step, one_m)
+    out_ref[:] = mul(acc, one)  # leave the Montgomery domain
+
+
+@functools.partial(
+    jax.jit, static_argnames=("exp_bits", "k", "interpret", "tile")
+)
+def rns_modexp_pallas(
+    base_res, exp, a2n_res, c1, nbmr, shared, *, exp_bits, k,
+    interpret=False, tile=None,
+):
+    """base^exp per row, the whole window loop fused in one Pallas launch.
+
+    base_res, a2n_res: (R, 2k+1) uint32 residues; exp: (R, EL) 16-bit
+    limbs; c1: (R, k); nbmr: (R, k+1); shared: as rns_mont_mul_pallas.
+    """
+    rows, C = base_res.shape
+    t = tile or _row_tile(rows, cap=128)
+    grid = (rows // t,)
+
+    def row_spec(width):
+        return pl.BlockSpec((t, width), lambda i: (i, 0))
+
+    def const_spec(a):
+        return pl.BlockSpec(a.shape, lambda i: (0,) * a.ndim)
+
+    (m_all, u_all, T1l, T1h, T2l, T2h, ainv, c2, bmoda, binvr) = shared
+    kernel = functools.partial(_modexp_kernel_pallas, k=k, exp_bits=exp_bits)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, C), jnp.uint32),
+        grid=grid,
+        in_specs=[
+            row_spec(C),  # base residues
+            row_spec(exp.shape[1]),  # exponent limbs
+            row_spec(C),  # A^2 mod n residues
+            row_spec(k),  # c1
+            row_spec(k + 1),  # nbmr
+            const_spec(m_all),
+            const_spec(u_all),
+            const_spec(T1l),
+            const_spec(T1h),
+            const_spec(T2l),
+            const_spec(T2h),
+            const_spec(ainv),
+            const_spec(c2),
+            const_spec(bmoda),
+            const_spec(binvr),
+        ],
+        out_specs=row_spec(C),
+        scratch_shapes=[
+            pltpu.VMEM((1 << WINDOW_BITS, t, C), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(
+        base_res, exp, a2n_res, c1, nbmr,
+        m_all, u_all, T1l, T1h, T2l, T2h, ainv, c2, bmoda, binvr,
+    )

@@ -43,6 +43,7 @@ kernel, so wall-clock is ~1.27 RNS MontMuls per exponent bit.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -57,6 +58,22 @@ __all__ = ["RNSBases", "rns_modexp", "rns_bases_for_bits"]
 
 _U32 = jnp.uint32
 _LANE = 128  # matmul contraction chunk: k-slices of <= 128 keep f32 sums exact
+
+
+def _pallas_mode() -> int:
+    """0 = plain XLA chain; 1 = fused Pallas MontMul (ops.pallas_rns);
+    2 = Pallas in interpret mode (CPU tests). FSDKR_PALLAS=0/1 forces;
+    default 'auto' uses Pallas on real TPU only."""
+    mode = os.environ.get("FSDKR_PALLAS", "auto")
+    if mode == "0":
+        return 0
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        on_tpu = False
+    if mode == "1":
+        return 1 if on_tpu else 2
+    return 1 if on_tpu else 0
 
 
 def _gen_channel_primes(count: int) -> List[int]:
@@ -249,6 +266,18 @@ def _rns_mont_mul(x, y, consts):
     """One RNS Montgomery product. x, y, out: (R, 2k+1) residues
     (channels ordered A | B | m_r)."""
     k = consts["k"]
+    if consts.get("pallas"):
+        from .pallas_rns import rns_mont_mul_pallas
+
+        return rns_mont_mul_pallas(
+            x,
+            y,
+            consts["c1_A"],
+            consts["N_Bmr"],
+            consts["pallas"],
+            k=k,
+            interpret=consts["pallas_interpret"],
+        )
     m_all, u_all = consts["m_all"], consts["u_all"]
     d = _mulmod(x, y, m_all, u_all)
     d_A = d[:, :k]
@@ -282,9 +311,29 @@ def _limbs_to_residues(limbs, consts):
     return _matmul_mod(limbs, consts["Ws"], consts["m_all"], consts["u_all"])
 
 
-@partial(jax.jit, static_argnames=("exp_bits", "k"))
+def _pallas_shared(consts_arrays):
+    """Shape the shared constants for ops.pallas_rns (rank >= 2)."""
+    (m_all, u_all, T1l, T1h, T2l, T2h, Ainv_B, c2_B, B_mod_A, Binv_r, _Wl, _Wh) = (
+        consts_arrays
+    )
+    return (
+        m_all[None, :],
+        u_all[None, :],
+        T1l,
+        T1h,
+        T2l,
+        T2h,
+        Ainv_B[None, :],
+        c2_B[None, :],
+        B_mod_A[None, :],
+        Binv_r.reshape(1, 1),
+    )
+
+
+@partial(jax.jit, static_argnames=("exp_bits", "k", "pallas_mode"))
 def _rns_modexp_kernel(
-    base_limbs, exp, a2n_limbs, c1_A, N_Bmr, consts_arrays, *, exp_bits, k
+    base_limbs, exp, a2n_limbs, c1_A, N_Bmr, consts_arrays, *, exp_bits, k,
+    pallas_mode=0,
 ):
     """base^exp per row. All big values arrive as 16-bit limb tensors and
     convert to residues on device. Returns the full residue rows (host
@@ -315,6 +364,8 @@ def _rns_modexp_kernel(
         Binv_r=Binv_r,
         c1_A=c1_A,
         N_Bmr=N_Bmr,
+        pallas=_pallas_shared(consts_arrays) if pallas_mode else None,
+        pallas_interpret=pallas_mode == 2,
     )
 
     base_res = _limbs_to_residues(base_limbs, consts)
@@ -374,9 +425,40 @@ def _prep_consts(bases: RNSBases):
     )
 
 
-@partial(jax.jit, static_argnames=("exp_bits", "k"))
+@partial(jax.jit, static_argnames=("exp_bits", "k", "interpret"))
+def _rns_modexp_full_pallas(
+    base_limbs, exp, a2n_limbs, c1_A, N_Bmr, consts_arrays, *, exp_bits, k,
+    interpret,
+):
+    """Whole-modexp fusion: limb->residue conversion in XLA (one matmul),
+    then ops.pallas_rns.rns_modexp_pallas runs the entire window loop in
+    VMEM (table + accumulator never touch HBM)."""
+    (m_all, u_all, _T1l, _T1h, _T2l, _T2h, _AinvB, _c2B, _BmodA, _Binvr, Wl, Wh) = (
+        consts_arrays
+    )
+
+    def resplit(lo, hi):
+        ksz = lo.shape[0]
+        return [
+            (lo[s : s + _LANE], hi[s : s + _LANE], s, min(_LANE, ksz - s))
+            for s in range(0, ksz, _LANE)
+        ]
+
+    conv = dict(m_all=m_all, u_all=u_all, Ws=resplit(Wl, Wh))
+    base_res = _limbs_to_residues(base_limbs, conv)
+    a2n_res = _limbs_to_residues(a2n_limbs, conv)
+    from .pallas_rns import rns_modexp_pallas
+
+    return rns_modexp_pallas(
+        base_res, exp, a2n_res, c1_A, N_Bmr, _pallas_shared(consts_arrays),
+        exp_bits=exp_bits, k=k, interpret=interpret,
+    )
+
+
+@partial(jax.jit, static_argnames=("exp_bits", "k", "pallas_mode"))
 def _rns_shared_modexp_kernel(
-    powers_limbs, exp, a2n_limbs, c1_A, N_Bmr, consts_arrays, *, exp_bits, k
+    powers_limbs, exp, a2n_limbs, c1_A, N_Bmr, consts_arrays, *, exp_bits, k,
+    pallas_mode=0,
 ):
     """Fixed-base comb over RNS MontMuls: groups share (base, modulus).
 
@@ -419,6 +501,8 @@ def _rns_shared_modexp_kernel(
             Binv_r=Binv_r,
             c1_A=c1_rows,
             N_Bmr=n_rows,
+            pallas=_pallas_shared(consts_arrays) if pallas_mode else None,
+            pallas_interpret=pallas_mode == 2,
         )
 
     # group consts broadcast to the three batch layouts used below
@@ -491,6 +575,7 @@ def rns_modexp_shared(
     exps_per_group: Sequence[Sequence[int]],
     moduli: Sequence[int],
     value_bits: int,
+    mesh=None,
 ) -> List[List[int]]:
     """Fixed-base comb through the RNS/MXU pipeline:
     bases[g]^exps[g][m] mod moduli[g]. The per-group power ladder runs on
@@ -553,16 +638,24 @@ def rns_modexp_shared(
         flat_exps.extend(list(grp) + [0] * (m_max - len(grp)))
     exp_limbs = ints_to_limbs(flat_exps, el).reshape(g_cnt, m_max, el)
 
-    out_res = _rns_shared_modexp_kernel(
+    args = (
         jnp.asarray(powers_limbs),
         jnp.asarray(exp_limbs),
         jnp.asarray(ints_to_limbs(a2n, num_limbs)),
         jnp.asarray(c1),
         jnp.asarray(n_bmr),
         _prep_consts(rb),
-        exp_bits=exp_bits,
-        k=k,
     )
+    if mesh is not None and g_cnt % int(mesh.devices.size) == 0:
+        from ..parallel.shard_kernels import sharded_rns_shared_modexp_fn
+
+        out_res = sharded_rns_shared_modexp_fn(mesh, exp_bits, k, _pallas_mode())(
+            *args
+        )
+    else:
+        out_res = _rns_shared_modexp_kernel(
+            *args, exp_bits=exp_bits, k=k, pallas_mode=_pallas_mode()
+        )
     res = np.asarray(out_res).reshape(g_cnt, m_max, 2 * k + 1)
 
     out: List[List[int]] = []
@@ -587,6 +680,7 @@ def rns_modexp(
     exps: Sequence[int],
     moduli: Sequence[int],
     value_bits: int,
+    mesh=None,
 ) -> List[int]:
     """bases^exps mod moduli row-wise through the RNS/MXU pipeline."""
     if not bases_int:
@@ -629,16 +723,25 @@ def rns_modexp(
             n_bmr[r, :k] = [3 % b for b in rb.B_primes]
             n_bmr[r, k] = 3 % rb.m_r
 
-    out_res = _rns_modexp_kernel(
+    args = (
         jnp.asarray(ints_to_limbs([b % n for b, n in zip(bases_int, moduli)], num_limbs)),
         jnp.asarray(ints_to_limbs(list(exps), el)),
         jnp.asarray(ints_to_limbs(a2n, num_limbs)),
         jnp.asarray(c1),
         jnp.asarray(n_bmr),
         _prep_consts(rb),
-        exp_bits=exp_bits,
-        k=k,
     )
+    pmode = _pallas_mode()
+    if mesh is not None and rows % int(mesh.devices.size) == 0:
+        from ..parallel.shard_kernels import sharded_rns_modexp_fn
+
+        out_res = sharded_rns_modexp_fn(mesh, exp_bits, k, pmode)(*args)
+    elif pmode:
+        out_res = _rns_modexp_full_pallas(
+            *args, exp_bits=exp_bits, k=k, interpret=pmode == 2
+        )
+    else:
+        out_res = _rns_modexp_kernel(*args, exp_bits=exp_bits, k=k)
     res = np.asarray(out_res)
 
     # host CRT exit: xi_i = |v_i * (A/a_i)^{-1}|_{a_i}, v = sum xi_i A/a_i mod A

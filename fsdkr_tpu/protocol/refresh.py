@@ -343,127 +343,285 @@ class RefreshMessage:
     ) -> None:
         """Receiver path — the north-star O(n^2) verification loop,
         executed as per-family batches (reference :321-467)."""
+        err = RefreshMessage.collect_sessions(
+            [(refresh_messages, local_key, new_dk, tuple(join_messages))], config
+        )[0]
+        if err is not None:
+            raise err
+
+    @staticmethod
+    def collect_sessions(
+        sessions: Sequence[
+            Tuple[
+                Sequence["RefreshMessage"],
+                LocalKey,
+                DecryptionKey,
+                Sequence["JoinMessage"],
+            ]
+        ],
+        config: ProtocolConfig = DEFAULT_CONFIG,
+    ) -> List[Optional[Exception]]:
+        """collect() for many INDEPENDENT refresh sessions with every
+        verification family fused across sessions into one batch launch
+        (the session-stacked layout of BASELINE.json config 5: 64 n=16
+        sessions feed the same row axis one n=256 session would, and the
+        rows shard over the configured mesh like any other batch).
+
+        Per session the semantics are exactly `collect`'s: same check
+        order, same identifiable-abort error types, same LocalKey
+        mutation points. Returns one entry per session — None on success
+        or the exception `collect` would have raised (a failing session
+        never blocks the others).
+        """
         backend = get_backend(config)
-        new_n = len(refresh_messages) + len(join_messages)
-        RefreshMessage.validate_collect(refresh_messages, local_key.t, new_n, config)
+        S = len(sessions)
+        errors: List[Optional[Exception]] = [None] * S
+        new_ns: List[int] = [0] * S
 
-        # ---- gather the O(n^2) PDL + range instances ------------------
-        pdl_items = []
-        range_items = []
-        for msg in refresh_messages:
-            for i in range(new_n):
-                st = PDLwSlackStatement(
-                    ciphertext=msg.points_encrypted_vec[i],
-                    ek=local_key.paillier_key_vec[i],
-                    Q=msg.points_committed_vec[i],
-                    G=GENERATOR,
-                    h1=local_key.h1_h2_n_tilde_vec[i].g,
-                    h2=local_key.h1_h2_n_tilde_vec[i].ni,
-                    N_tilde=local_key.h1_h2_n_tilde_vec[i].N,
-                )
-                pdl_items.append((msg.pdl_proof_vec[i], st))
-                range_items.append(
-                    (
-                        msg.range_proofs[i],
-                        msg.points_encrypted_vec[i],
-                        local_key.paillier_key_vec[i],
-                        local_key.h1_h2_n_tilde_vec[i],
+        def alive():
+            return [s for s in range(S) if errors[s] is None]
+
+        def fused(call, items, spans):
+            """Run one fused backend launch; if a malformed session makes
+            the whole batch raise (e.g. a crafted proof field the batch
+            codec rejects), isolate per session so the bad session gets
+            the error and the others still verify — the "a failing
+            session never blocks the others" guarantee."""
+            try:
+                return call(items)
+            except Exception:
+                out: list = [None] * len(items)
+                for s, (lo, hi) in spans.items():
+                    if errors[s] is not None:
+                        continue
+                    try:
+                        out[lo:hi] = call(items[lo:hi])
+                    except Exception as e:
+                        errors[s] = e  # rows stay None; phases skip s
+                return out
+
+        # ---- structure checks + fused Feldman validation --------------
+        # (validate_collect semantics, reference :147-191)
+        feld_items: list = []
+        feld_spans: Dict[int, Tuple[int, int]] = {}
+        for s, (msgs, key, _dk, joins) in enumerate(sessions):
+            new_n = len(msgs) + len(joins)
+            new_ns[s] = new_n
+            try:
+                if len(msgs) <= key.t:
+                    raise PartiesThresholdViolation(
+                        threshold=key.t, refreshed_keys=len(msgs)
                     )
-                )
+                for k, msg in enumerate(msgs):
+                    lens = (
+                        len(msg.pdl_proof_vec),
+                        len(msg.points_committed_vec),
+                        len(msg.points_encrypted_vec),
+                    )
+                    if any(l != new_n for l in lens) or len(msg.range_proofs) != new_n:
+                        raise SizeMismatchError(k, *lens)
+            except Exception as e:
+                errors[s] = e
+                continue
+            lo = len(feld_items)
+            feld_items.extend(
+                (msg.coefficients_committed_vec, msg.points_committed_vec[i], i + 1)
+                for msg in msgs
+                for i in range(new_n)
+            )
+            feld_spans[s] = (lo, len(feld_items))
+        if feld_items:
+            feld_verdicts = fused(backend.validate_feldman, feld_items, feld_spans)
+            for s, (lo, hi) in feld_spans.items():
+                if errors[s] is None and not all(feld_verdicts[lo:hi]):
+                    errors[s] = PublicShareValidationError()
 
-        pdl_verdicts = backend.verify_pdl(pdl_items)
-        range_verdicts = backend.verify_range(range_items)
+        # ---- gather the O(n^2) PDL + range instances, all sessions ----
+        pdl_items: list = []
+        range_items: list = []
+        pair_spans: Dict[int, Tuple[int, int]] = {}
+        for s in alive():
+            msgs, key, _dk, _joins = sessions[s]
+            new_n = new_ns[s]
+            lo = len(pdl_items)
+            for msg in msgs:
+                for i in range(new_n):
+                    st = PDLwSlackStatement(
+                        ciphertext=msg.points_encrypted_vec[i],
+                        ek=key.paillier_key_vec[i],
+                        Q=msg.points_committed_vec[i],
+                        G=GENERATOR,
+                        h1=key.h1_h2_n_tilde_vec[i].g,
+                        h2=key.h1_h2_n_tilde_vec[i].ni,
+                        N_tilde=key.h1_h2_n_tilde_vec[i].N,
+                    )
+                    pdl_items.append((msg.pdl_proof_vec[i], st))
+                    range_items.append(
+                        (
+                            msg.range_proofs[i],
+                            msg.points_encrypted_vec[i],
+                            key.paillier_key_vec[i],
+                            key.h1_h2_n_tilde_vec[i],
+                        )
+                    )
+            pair_spans[s] = (lo, len(pdl_items))
 
-        # attribution in the reference's loop order (msg outer, i inner;
-        # PDL before range — src/refresh_message.rs:330-350)
-        row = 0
-        for msg in refresh_messages:
-            for i in range(new_n):
-                if pdl_verdicts[row] is not None:
-                    raise PDLwSlackProofError(*pdl_verdicts[row])
-                if not range_verdicts[row]:
-                    raise RangeProofError(party_index=i)
-                row += 1
+        if pdl_items:
+            pdl_verdicts = fused(backend.verify_pdl, pdl_items, pair_spans)
+            range_verdicts = fused(backend.verify_range, range_items, pair_spans)
+            # attribution in the reference's loop order (msg outer, i
+            # inner; PDL before range — src/refresh_message.rs:330-350)
+            for s, (start, _hi) in pair_spans.items():
+                if errors[s] is not None:
+                    continue
+                msgs, _key, _dk, _joins = sessions[s]
+                row = start
+                try:
+                    for msg in msgs:
+                        for i in range(new_ns[s]):
+                            if pdl_verdicts[row] is not None:
+                                raise PDLwSlackProofError(*pdl_verdicts[row])
+                            if not range_verdicts[row]:
+                                raise RangeProofError(party_index=i)
+                            row += 1
+                except Exception as e:
+                    errors[s] = e
 
         # ---- ring-Pedersen batches (reference :352-365) ---------------
-        rp_items = [
-            (m.ring_pedersen_proof, m.ring_pedersen_statement) for m in refresh_messages
-        ] + [(j.ring_pedersen_proof, j.ring_pedersen_statement) for j in join_messages]
-        rp_verdicts = backend.verify_ring_pedersen(rp_items, config.m_security)
-        for verdict in rp_verdicts:
-            if not verdict:
-                raise RingPedersenProofError()
+        rp_items: list = []
+        rp_spans: Dict[int, Tuple[int, int]] = {}
+        for s in alive():
+            msgs, _key, _dk, joins = sessions[s]
+            lo = len(rp_items)
+            rp_items += [
+                (m.ring_pedersen_proof, m.ring_pedersen_statement) for m in msgs
+            ] + [(j.ring_pedersen_proof, j.ring_pedersen_statement) for j in joins]
+            rp_spans[s] = (lo, len(rp_items))
+        if rp_items:
+            rp_verdicts = fused(
+                lambda items: backend.verify_ring_pedersen(items, config.m_security),
+                rp_items,
+                rp_spans,
+            )
+            for s, (lo, hi) in rp_spans.items():
+                if errors[s] is None and not all(rp_verdicts[lo:hi]):
+                    errors[s] = RingPedersenProofError()
 
         # ---- share recovery inputs (reference :367-373) ---------------
-        old_ek = local_key.paillier_key_vec[local_key.i - 1]
-        cipher_sum, li_vec = RefreshMessage.get_ciphertext_sum(
-            refresh_messages,
-            local_key.i,
-            local_key.vss_scheme.parameters,
-            old_ek,
-        )
+        sums: Dict[int, tuple] = {}
+        for s in alive():
+            msgs, key, _dk, _joins = sessions[s]
+            try:
+                old_ek = key.paillier_key_vec[key.i - 1]
+                cipher_sum, li_vec = RefreshMessage.get_ciphertext_sum(
+                    msgs, key.i, key.vss_scheme.parameters, old_ek
+                )
+                sums[s] = (old_ek, cipher_sum, li_vec)
+            except Exception as e:
+                errors[s] = e
 
-        # ---- Paillier correct-key batch (reference :375-396) ----------
-        ck_items = [
-            (m.dk_correctness_proof, m.ek) for m in refresh_messages
-        ] + [(j.dk_correctness_proof, j.ek) for j in join_messages]
-        ck_verdicts = backend.verify_correct_key(ck_items, config.correct_key_rounds)
-
-        for k, msg in enumerate(refresh_messages):
-            if not ck_verdicts[k]:
-                raise PaillierVerificationError(party_index=msg.party_index)
-            n_len = msg.ek.n.bit_length()
-            if n_len > config.paillier_bits or n_len < config.paillier_bits - 1:
-                raise ModuliTooSmall(party_index=msg.party_index, moduli_size=n_len)
-            local_key.paillier_key_vec[msg.party_index - 1] = msg.ek
-
-        # ---- join messages: dk proof + composite dlog both bases ------
-        dlog_items = []
-        for join in join_messages:
-            inverse_st = DLogStatement(
-                N=join.dlog_statement.N,
-                g=join.dlog_statement.ni,
-                ni=join.dlog_statement.g,
+        # ---- Paillier correct-key + composite dlog, fused -------------
+        ck_items: list = []
+        ck_spans: Dict[int, Tuple[int, int]] = {}
+        dlog_items: list = []
+        dlog_spans: Dict[int, Tuple[int, int]] = {}
+        for s in alive():
+            msgs, _key, _dk, joins = sessions[s]
+            ck_lo = len(ck_items)
+            ck_items += [(m.dk_correctness_proof, m.ek) for m in msgs]
+            ck_items += [(j.dk_correctness_proof, j.ek) for j in joins]
+            ck_spans[s] = (ck_lo, len(ck_items))
+            dlog_lo = len(dlog_items)
+            for join in joins:
+                inverse_st = DLogStatement(
+                    N=join.dlog_statement.N,
+                    g=join.dlog_statement.ni,
+                    ni=join.dlog_statement.g,
+                )
+                dlog_items.append(
+                    (join.composite_dlog_proof_base_h1, join.dlog_statement)
+                )
+                dlog_items.append((join.composite_dlog_proof_base_h2, inverse_st))
+            dlog_spans[s] = (dlog_lo, len(dlog_items))
+        ck_verdicts = (
+            fused(
+                lambda items: backend.verify_correct_key(
+                    items, config.correct_key_rounds
+                ),
+                ck_items,
+                ck_spans,
             )
-            dlog_items.append((join.composite_dlog_proof_base_h1, join.dlog_statement))
-            dlog_items.append((join.composite_dlog_proof_base_h2, inverse_st))
-        dlog_verdicts = backend.verify_composite_dlog(dlog_items)
-
-        for k, join in enumerate(join_messages):
-            party_index = join.get_party_index()
-            if not ck_verdicts[len(refresh_messages) + k]:
-                raise PaillierVerificationError(party_index=party_index)
-            if not (dlog_verdicts[2 * k] and dlog_verdicts[2 * k + 1]):
-                raise DLogProofValidation(party_index=party_index)
-            n_len = join.ek.n.bit_length()
-            if n_len > config.paillier_bits or n_len < config.paillier_bits - 1:
-                raise ModuliTooSmall(party_index=party_index, moduli_size=n_len)
-            local_key.paillier_key_vec[party_index - 1] = join.ek
-
-        # ---- decrypt own new share; rotate key material ---------------
-        new_share = paillier.decrypt(local_key.paillier_dk, old_ek, cipher_sum)
-        new_share_fe = Scalar.from_int(new_share)
-
-        # pk_vec rebuild by assignment — conscious fix of quirk 1
-        # (reference :455-464 uses Vec::insert)
-        pk_vec = combine_committed_points(
-            refresh_messages, li_vec, local_key.t, new_n
+            if ck_items
+            else []
+        )
+        dlog_verdicts = (
+            fused(backend.verify_composite_dlog, dlog_items, dlog_spans)
+            if dlog_items
+            else []
         )
 
-        # consistency gate absent from the reference: the decrypted share
-        # must match the Feldman-committed public share, or the key would be
-        # silently corrupted (e.g. by a plaintext wrap mod a too-small
-        # Paillier modulus)
-        if GENERATOR * new_share_fe != pk_vec[local_key.i - 1]:
-            raise PublicShareValidationError()
+        # ---- per-session adoption gates + key rotation ----------------
+        # (mutating phase; order and mutation points match collect /
+        # reference :375-467 — a failure mid-way leaves the same partial
+        # paillier_key_vec updates the reference would)
+        for s in alive():
+            msgs, local_key, new_dk, joins = sessions[s]
+            new_n = new_ns[s]
+            ck0, d0 = ck_spans[s][0], dlog_spans[s][0]
+            try:
+                for k, msg in enumerate(msgs):
+                    if not ck_verdicts[ck0 + k]:
+                        raise PaillierVerificationError(party_index=msg.party_index)
+                    n_len = msg.ek.n.bit_length()
+                    if n_len > config.paillier_bits or n_len < config.paillier_bits - 1:
+                        raise ModuliTooSmall(
+                            party_index=msg.party_index, moduli_size=n_len
+                        )
+                    local_key.paillier_key_vec[msg.party_index - 1] = msg.ek
 
-        # zeroize the old dk, install the new one (reference :445-448)
-        local_key.paillier_dk.zeroize()
-        local_key.paillier_dk = new_dk
+                for k, join in enumerate(joins):
+                    party_index = join.get_party_index()
+                    if not ck_verdicts[ck0 + len(msgs) + k]:
+                        raise PaillierVerificationError(party_index=party_index)
+                    if not (dlog_verdicts[d0 + 2 * k] and dlog_verdicts[d0 + 2 * k + 1]):
+                        raise DLogProofValidation(party_index=party_index)
+                    n_len = join.ek.n.bit_length()
+                    if n_len > config.paillier_bits or n_len < config.paillier_bits - 1:
+                        raise ModuliTooSmall(
+                            party_index=party_index, moduli_size=n_len
+                        )
+                    local_key.paillier_key_vec[party_index - 1] = join.ek
 
-        local_key.keys_linear.x_i = new_share_fe
-        local_key.keys_linear.y = GENERATOR * new_share_fe
-        local_key.pk_vec = pk_vec
+                # ---- decrypt own new share; rotate key material -------
+                old_ek, cipher_sum, li_vec = sums[s]
+                new_share = paillier.decrypt(
+                    local_key.paillier_dk, old_ek, cipher_sum
+                )
+                new_share_fe = Scalar.from_int(new_share)
+
+                # pk_vec rebuild by assignment — conscious fix of quirk 1
+                # (reference :455-464 uses Vec::insert)
+                pk_vec = combine_committed_points(
+                    msgs, li_vec, local_key.t, new_n
+                )
+
+                # consistency gate absent from the reference: the decrypted
+                # share must match the Feldman-committed public share, or
+                # the key would be silently corrupted (e.g. by a plaintext
+                # wrap mod a too-small Paillier modulus)
+                if GENERATOR * new_share_fe != pk_vec[local_key.i - 1]:
+                    raise PublicShareValidationError()
+
+                # zeroize the old dk, install the new one (reference :445-448)
+                local_key.paillier_dk.zeroize()
+                local_key.paillier_dk = new_dk
+
+                local_key.keys_linear.x_i = new_share_fe
+                local_key.keys_linear.y = GENERATOR * new_share_fe
+                local_key.pk_vec = pk_vec
+            except Exception as e:
+                errors[s] = e
+        return errors
 
 
 def combine_committed_points(

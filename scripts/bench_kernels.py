@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Kernel-level sweep: CIOS vs RNS (XLA chain vs fused Pallas MontMul),
+generic vs fixed-base comb, across modulus widths and batch sizes, on
+the real chip. Produces the measured numbers that set the powm router
+thresholds (FSDKR_RNS_MIN_ROWS & friends, backend/powm.py) and the
+BASELINE.md kernel table.
+
+Usage: python scripts/bench_kernels.py [quick|full]
+Output: one human table to stderr + JSON lines to stdout, one per
+measured point:
+  {"kernel": "...", "bits": N, "exp_bits": N, "rows": N, "seconds": S,
+   "modexp_per_s": X}
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _workload(bits, exp_bits, rows, seed=0):
+    import random
+
+    rng = random.Random(seed)
+    moduli = [
+        rng.getrandbits(bits) | (1 << (bits - 1)) | 1 for _ in range(rows)
+    ]
+    bases = [rng.getrandbits(bits - 1) for _ in range(rows)]
+    exps = [rng.getrandbits(exp_bits) | (1 << (exp_bits - 1)) for _ in range(rows)]
+    return bases, exps, moduli
+
+
+def _grouped_workload(bits, exp_bits, groups, rows_per_group, seed=0):
+    import random
+
+    rng = random.Random(seed)
+    gmods = [rng.getrandbits(bits) | (1 << (bits - 1)) | 1 for _ in range(groups)]
+    gbases = [rng.getrandbits(bits - 1) for _ in range(groups)]
+    gexps = [
+        [rng.getrandbits(exp_bits) | (1 << (exp_bits - 1)) for _ in range(rows_per_group)]
+        for _ in range(groups)
+    ]
+    return gbases, gexps, gmods
+
+
+def _time(fn, warmups=1, reps=2):
+    for _ in range(warmups):
+        fn()
+    t0 = time.time()
+    for _ in range(reps):
+        fn()
+    return (time.time() - t0) / reps
+
+
+def measure_generic(kind, bits, exp_bits, rows, spot_check=True):
+    from fsdkr_tpu.ops.limbs import limbs_for_bits
+    from fsdkr_tpu.ops.montgomery import BatchModExp
+    from fsdkr_tpu.ops import rns
+
+    bases, exps, moduli = _workload(bits, exp_bits, rows)
+    if kind == "cios":
+        ctx = BatchModExp(moduli, limbs_for_bits(bits))
+        run = lambda: ctx.modexp(bases, exps)
+    elif kind in ("rns", "rns-pallas"):
+        os.environ["FSDKR_PALLAS"] = "1" if kind == "rns-pallas" else "0"
+        run = lambda: rns.rns_modexp(bases, exps, moduli, bits)
+    else:
+        raise ValueError(kind)
+    out = run()  # correctness + compile
+    if spot_check:
+        for i in (0, rows // 2, rows - 1):
+            assert out[i] == pow(bases[i] % moduli[i], exps[i], moduli[i]), (
+                f"{kind} wrong at row {i}"
+            )
+    dt = _time(run)
+    rec = {
+        "kernel": kind,
+        "bits": bits,
+        "exp_bits": exp_bits,
+        "rows": rows,
+        "seconds": round(dt, 4),
+        "modexp_per_s": round(rows / dt, 1),
+    }
+    print(json.dumps(rec), flush=True)
+    log(f"  {kind:12s} bits={bits} e={exp_bits} rows={rows}: "
+        f"{dt:.3f}s -> {rows / dt:.0f}/s")
+    return rec
+
+
+def measure_comb(kind, bits, exp_bits, groups, rows_per_group, spot_check=True):
+    from fsdkr_tpu.ops.limbs import limbs_for_bits
+    from fsdkr_tpu.ops.montgomery import shared_base_modexp
+    from fsdkr_tpu.ops import rns
+
+    gbases, gexps, gmods = _grouped_workload(bits, exp_bits, groups, rows_per_group)
+    if kind == "comb-cios":
+        run = lambda: shared_base_modexp(
+            gbases, gexps, gmods, limbs_for_bits(bits)
+        )
+    elif kind in ("comb-rns", "comb-rns-pallas"):
+        os.environ["FSDKR_PALLAS"] = "1" if kind == "comb-rns-pallas" else "0"
+        run = lambda: rns.rns_modexp_shared(gbases, gexps, gmods, bits)
+    else:
+        raise ValueError(kind)
+    out = run()
+    if spot_check:
+        g = groups // 2
+        assert out[g][0] == pow(
+            gbases[g] % gmods[g], gexps[g][0], gmods[g]
+        ), f"{kind} wrong"
+    dt = _time(run)
+    rows = groups * rows_per_group
+    rec = {
+        "kernel": kind,
+        "bits": bits,
+        "exp_bits": exp_bits,
+        "rows": rows,
+        "groups": groups,
+        "seconds": round(dt, 4),
+        "modexp_per_s": round(rows / dt, 1),
+    }
+    print(json.dumps(rec), flush=True)
+    log(f"  {kind:16s} bits={bits} e={exp_bits} G={groups}xM={rows_per_group}: "
+        f"{dt:.3f}s -> {rows / dt:.0f}/s")
+    return rec
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "quick"
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    except Exception:
+        pass
+    log(f"devices: {jax.devices()}  backend: {jax.default_backend()}")
+
+    # the collect() shapes that matter: 2048-bit (N~, ring-Pedersen N) and
+    # 4096-bit (Paillier N^2) moduli; 256-bit challenges, ~2048-bit secret
+    # exponents, 2304/2816-bit slack-range exponents
+    if mode == "quick":
+        generic_points = [
+            (2048, 256, 1024),
+            (2048, 2048, 1024),
+            (4096, 256, 1024),
+            (4096, 2048, 512),
+        ]
+        comb_points = [
+            (2048, 2048, 16, 256),  # ring-Pedersen @ n=16
+            (2048, 256, 16, 64),
+        ]
+        batch_sweep = [128, 512, 2048, 8192]
+    else:
+        generic_points = [
+            (2048, 256, 1024),
+            (2048, 2048, 1024),
+            (2048, 2560, 1024),
+            (4096, 256, 1024),
+            (4096, 2048, 512),
+            (4096, 3072, 512),
+        ]
+        comb_points = [
+            (2048, 2048, 16, 256),
+            (2048, 2048, 256, 256),  # ring-Pedersen @ n=256
+            (4096, 2048, 64, 64),
+            (2048, 256, 64, 64),
+        ]
+        batch_sweep = [128, 256, 512, 1024, 2048, 4096, 8192, 16384]
+
+    kinds = ["cios", "rns"]
+    if jax.default_backend() == "tpu":
+        kinds.append("rns-pallas")
+
+    log("== generic kernels ==")
+    for bits, e, rows in generic_points:
+        for kind in kinds:
+            try:
+                measure_generic(kind, bits, e, rows)
+            except Exception as ex:
+                log(f"  {kind} bits={bits} e={e} rows={rows}: FAILED {ex}")
+
+    log("== batch-size sweep (2048-bit, 2048-bit exp) ==")
+    for rows in batch_sweep:
+        for kind in kinds:
+            try:
+                measure_generic(kind, 2048, 2048, rows)
+            except Exception as ex:
+                log(f"  {kind} rows={rows}: FAILED {ex}")
+
+    log("== comb kernels ==")
+    comb_kinds = ["comb-cios", "comb-rns"]
+    if jax.default_backend() == "tpu":
+        comb_kinds.append("comb-rns-pallas")
+    for bits, e, g, m in comb_points:
+        for kind in comb_kinds:
+            try:
+                measure_comb(kind, bits, e, g, m)
+            except Exception as ex:
+                log(f"  {kind} bits={bits} e={e} G={g} M={m}: FAILED {ex}")
+
+
+if __name__ == "__main__":
+    main()
