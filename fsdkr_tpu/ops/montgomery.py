@@ -27,7 +27,7 @@ exponent width while capping the number of compiled kernel variants.
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -431,6 +431,136 @@ def shared_base_modexp(
     return [
         flat[g * m_max : g * m_max + len(exps_per_group[g])] for g in range(g_cnt)
     ]
+
+
+@partial(jax.jit, static_argnames=("levels",))
+def _inv_tree_up_kernel(vals_m, n, n_prime, *, levels):
+    """Product tree ascent, all groups batched. vals_m: (G, M, K) values
+    in the Montgomery domain (x*R mod n), M = 2^levels; n/n_prime are
+    per-group, broadcast over the M axis by the caller's layout.
+    Returns the per-level arrays (for the descent) and the (G, 1, K)
+    roots. Montgomery products of domain values stay in domain."""
+    g, m, k = vals_m.shape
+    lvls = [vals_m]
+    cur = vals_m
+    for _ in range(levels):
+        half = cur.shape[1] // 2
+        a = cur[:, 0::2].reshape(g * half, k)
+        b = cur[:, 1::2].reshape(g * half, k)
+        nn = jnp.broadcast_to(n[:, None], (g, half, k)).reshape(g * half, k)
+        npp = jnp.broadcast_to(n_prime[:, None], (g, half)).reshape(g * half)
+        cur = mont_mul_limbs(a, b, nn, npp).reshape(g, half, k)
+        lvls.append(cur)
+    return tuple(lvls)
+
+
+@partial(jax.jit, static_argnames=("levels",))
+def _inv_tree_down_kernel(lvls, root_inv_m, n, n_prime, *, levels):
+    """Descent: inv(left child) = inv(parent) * right sibling, and vice
+    versa. root_inv_m: (G, 1, K) Montgomery-domain inverse of each
+    group's root. Returns (G, M, K) per-leaf inverses (Montgomery
+    domain)."""
+    g, _, k = root_inv_m.shape
+    inv = root_inv_m
+    for lvl in range(levels - 1, -1, -1):
+        sib = lvls[lvl]  # (G, 2*half, K)
+        half = sib.shape[1] // 2
+        left = sib[:, 0::2].reshape(g * half, k)
+        right = sib[:, 1::2].reshape(g * half, k)
+        par = inv.reshape(g * half, k)
+        nn = jnp.broadcast_to(n[:, None], (g, half, k)).reshape(g * half, k)
+        npp = jnp.broadcast_to(n_prime[:, None], (g, half)).reshape(g * half)
+        inv_left = mont_mul_limbs(par, right, nn, npp).reshape(g, half, k)
+        inv_right = mont_mul_limbs(par, left, nn, npp).reshape(g, half, k)
+        inv = jnp.stack([inv_left, inv_right], axis=2).reshape(g, 2 * half, k)
+    return inv
+
+
+def batch_mod_inv_grouped(
+    groups: Sequence[Tuple[int, Sequence[int]]], num_limbs: int
+):
+    """Batched modular inversion via a device-side Montgomery product
+    tree: for each (modulus, values) group, ONE host inversion of the
+    tree root replaces len(values) serial CPython `pow(v, -1, m)` calls
+    (467 us each at 2048 bits, 1.7 ms at 4096 — the O(n^2) range-proof
+    loop at n=256 would spend ~450 s there; the tree's 2M on-device
+    Montgomery products are noise next to the modexp work).
+
+    Returns a list of per-group lists; a non-invertible value poisons
+    only its own group, which falls back to per-row host inversion (an
+    adversarial input can force the slow path for its group, never a
+    wrong result — same policy as the RLC EC fallback).
+    """
+    from .limbs import MontgomeryContext
+
+    if not groups:
+        return []
+    g_cnt = len(groups)
+    m_max = max(len(vs) for _, vs in groups)
+    levels = max(1, (m_max - 1).bit_length())
+    m_pad = 1 << levels
+
+    ctx = MontgomeryContext([m for m, _ in groups], num_limbs)
+    r = 1 << (LIMB_BITS * num_limbs)
+    flat: List[int] = []
+    for (mod, vs) in groups:
+        # Montgomery domain (x*R mod n); pad with R (domain rep of 1)
+        flat.extend(v % mod * r % mod for v in vs)
+        flat.extend([r % mod] * (m_pad - len(vs)))
+    vals_m = jnp.asarray(
+        ints_to_limbs(flat, num_limbs).reshape(g_cnt, m_pad, num_limbs)
+    )
+    n = jnp.asarray(ctx.n)
+    n_prime = jnp.asarray(ctx.n_prime)
+
+    lvls = _inv_tree_up_kernel(vals_m, n, n_prime, levels=levels)
+    roots_m = np.asarray(lvls[-1]).reshape(g_cnt, num_limbs)
+    # roots are x*R mod n; R^{-1} factors cancel in pairs up the tree so
+    # root_m = (prod v_i) * R mod n — host-invert the plain product
+    roots = limbs_to_ints(roots_m)
+    out: List[Optional[List[int]]] = [None] * g_cnt
+    root_inv_m: List[int] = []
+    live: List[int] = []
+    for gi, ((mod, vs), rt) in enumerate(zip(groups, roots)):
+        try:
+            inv = pow(rt * pow(r, -1, mod) % mod, -1, mod)
+            root_inv_m.append(inv * r % mod)
+            live.append(gi)
+        except ValueError:  # some value in the group not invertible
+            from ..core import intops
+
+            out[gi] = [intops.mod_inv(v, mod) for v in vs]
+            root_inv_m.append(1 * r % ctx.moduli[gi])  # dummy, discarded
+
+    inv_leaves = _inv_tree_down_kernel(
+        lvls[:-1],
+        jnp.asarray(ints_to_limbs(root_inv_m, num_limbs)).reshape(
+            g_cnt, 1, num_limbs
+        ),
+        n,
+        n_prime,
+        levels=levels,
+    )
+    # leave the Montgomery domain: montmul(x_m, 1) = x
+    flat_m = inv_leaves.reshape(g_cnt * m_pad, num_limbs)
+    one = jnp.zeros((g_cnt * m_pad, num_limbs), _U32).at[:, 0].set(1)
+    nn = jnp.broadcast_to(n[:, None], (g_cnt, m_pad, num_limbs)).reshape(
+        g_cnt * m_pad, num_limbs
+    )
+    npp = jnp.broadcast_to(
+        n_prime[:, None], (g_cnt, m_pad)
+    ).reshape(g_cnt * m_pad)
+    plain = np.asarray(_modmul_exit_kernel(flat_m, one, nn, npp))
+    leaf_ints = limbs_to_ints(plain)
+    for gi in live:
+        mod, vs = groups[gi]
+        out[gi] = leaf_ints[gi * m_pad : gi * m_pad + len(vs)]
+    return out
+
+
+@jax.jit
+def _modmul_exit_kernel(a_m, one, n, n_prime):
+    return mont_mul_limbs(a_m, one, n, n_prime)
 
 
 def batch_modexp(

@@ -18,9 +18,10 @@ Two kernels:
   (BASELINE.json). Per row tile: 2 + 14 table + 5*E/4 MontMuls, each
   two MXU base-extension matmuls.
 
-The matmuls run as 8-bit-split bf16 dots with f32 accumulation; with
-k <= 257 channels a full-width dot stays exact (255^2 * 257 < 2^24), so
-no chunking is needed inside a tile.
+The matmuls run as 8-bit-split bf16 dots with f32 accumulation, chunked
+at 128 contraction terms so every partial sum stays exact (255^2 * 128 <
+2^23; the 4096-bit width class has k = 260 channels, past the 2^24
+full-width exactness bound).
 
 Numerics are IDENTICAL to `_rns_mont_mul` (same fold bounds, same
 Shenoy correction); `tests/test_pallas.py` pins the kernels against the
@@ -59,10 +60,17 @@ def _mulmod(a, b, m, u16m):
     return _channel_mod(a * b, m, u16m)
 
 
+_LANE = 128  # contraction chunk: <=128-term 8-bit-split sums < 2^23, exact
+# in f32 — the 4096-bit class has k=260 channels, where a full-width dot
+# would exceed 2^24 and round (the same bound the XLA chain's _LANE
+# chunking enforces)
+
+
 def _matmul_mod(x, lo, hi, mods, u16m):
     """x (R, k) uint32 16-bit values, T pre-split bf16 (k, C): returns
-    (R, C) sums mod per-column modulus. Single full-width dot per split —
-    exact for k <= 257 (see module docstring)."""
+    (R, C) sums mod per-column modulus. The contraction is chunked at
+    _LANE terms so every f32-accumulated dot stays exact (static Python
+    loop — shapes are compile-time constants inside the kernel)."""
     xl = (x & jnp.uint32(0xFF)).astype(jnp.bfloat16)
     xh = (x >> 8).astype(jnp.bfloat16)
     dot = functools.partial(
@@ -70,18 +78,25 @@ def _matmul_mod(x, lo, hi, mods, u16m):
         precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32,
     )
-    pll = dot(xl, lo).astype(_U32)
-    plh = dot(xl, hi).astype(_U32)
-    phl = dot(xh, lo).astype(_U32)
-    phh = dot(xh, hi).astype(_U32)
-    # combine pll + 2^8(plh+phl) + 2^16 phh with interleaved folds; all
-    # intermediates stay < 2^31.2 for k <= 257 channels (u16m <= 8536)
-    t1 = _fold(plh + phl, u16m)
-    v = pll + (t1 << 8)
-    t2 = _fold(phh, u16m) << 8
-    t2 = _fold(_fold(t2, u16m), u16m)
-    v = v + (t2 << 8)
-    return _channel_mod(v, mods, u16m, folds=6)
+    k = x.shape[1]
+    out = None
+    for s in range(0, k, _LANE):
+        e = min(s + _LANE, k)
+        pll = dot(xl[:, s:e], lo[s:e]).astype(_U32)
+        plh = dot(xl[:, s:e], hi[s:e]).astype(_U32)
+        phl = dot(xh[:, s:e], lo[s:e]).astype(_U32)
+        phh = dot(xh[:, s:e], hi[s:e]).astype(_U32)
+        # combine pll + 2^8(plh+phl) + 2^16 phh with interleaved folds;
+        # all intermediates stay < 2^31 for <=128-term chunks
+        # (u16m <= 8536)
+        t1 = _fold(plh + phl, u16m)
+        v = pll + (t1 << 8)
+        t2 = _fold(phh, u16m) << 8
+        t2 = _fold(_fold(t2, u16m), u16m)
+        v = v + (t2 << 8)
+        part = _channel_mod(v, mods, u16m, folds=6)
+        out = part if out is None else out + part
+    return _channel_mod(out, mods, u16m, folds=1)
 
 
 def _mont_mul_body(x, y, c1, nbmr, consts, k):

@@ -60,6 +60,16 @@ _U32 = jnp.uint32
 _LANE = 128  # matmul contraction chunk: k-slices of <= 128 keep f32 sums exact
 
 
+def _resplit(lo, hi):
+    """Chunk a pre-split constant matrix along the contraction dim at
+    _LANE terms (f32 dot exactness bound)."""
+    ksz = lo.shape[0]
+    return [
+        (lo[s : s + _LANE], hi[s : s + _LANE], s, min(_LANE, ksz - s))
+        for s in range(0, ksz, _LANE)
+    ]
+
+
 def _pallas_mode() -> int:
     """0 = plain XLA chain; 1 = fused Pallas MontMul (ops.pallas_rns);
     2 = Pallas in interpret mode (CPU tests). FSDKR_PALLAS=0/1 forces;
@@ -342,20 +352,13 @@ def _rns_modexp_kernel(
         consts_arrays
     )
 
-    def resplit(lo, hi):
-        ksz = lo.shape[0]
-        return [
-            (lo[s : s + _LANE], hi[s : s + _LANE], s, min(_LANE, ksz - s))
-            for s in range(0, ksz, _LANE)
-        ]
-
     consts = dict(
         k=k,
         m_all=m_all,
         u_all=u_all,
-        T1s=resplit(T1l, T1h),
-        T2s=resplit(T2l, T2h),
-        Ws=resplit(Wl, Wh),
+        T1s=_resplit(T1l, T1h),
+        T2s=_resplit(T2l, T2h),
+        Ws=_resplit(Wl, Wh),
         mA_mr=jnp.concatenate([m_all[:k], m_all[2 * k :]]),
         uA_mr=jnp.concatenate([u_all[:k], u_all[2 * k :]]),
         Ainv_B=Ainv_B,
@@ -437,14 +440,7 @@ def _rns_modexp_full_pallas(
         consts_arrays
     )
 
-    def resplit(lo, hi):
-        ksz = lo.shape[0]
-        return [
-            (lo[s : s + _LANE], hi[s : s + _LANE], s, min(_LANE, ksz - s))
-            for s in range(0, ksz, _LANE)
-        ]
-
-    conv = dict(m_all=m_all, u_all=u_all, Ws=resplit(Wl, Wh))
+    conv = dict(m_all=m_all, u_all=u_all, Ws=_resplit(Wl, Wh))
     base_res = _limbs_to_residues(base_limbs, conv)
     a2n_res = _limbs_to_residues(a2n_limbs, conv)
     from .pallas_rns import rns_modexp_pallas
@@ -474,13 +470,6 @@ def _rns_shared_modexp_kernel(
         consts_arrays
     )
 
-    def resplit(lo, hi):
-        ksz = lo.shape[0]
-        return [
-            (lo[s : s + _LANE], hi[s : s + _LANE], s, min(_LANE, ksz - s))
-            for s in range(0, ksz, _LANE)
-        ]
-
     w_cnt, g, L = powers_limbs.shape
     m = exp.shape[1]
     c = 2 * k + 1
@@ -490,9 +479,9 @@ def _rns_shared_modexp_kernel(
             k=k,
             m_all=m_all,
             u_all=u_all,
-            T1s=resplit(T1l, T1h),
-            T2s=resplit(T2l, T2h),
-            Ws=resplit(Wl, Wh),
+            T1s=_resplit(T1l, T1h),
+            T2s=_resplit(T2l, T2h),
+            Ws=_resplit(Wl, Wh),
             mA_mr=jnp.concatenate([m_all[:k], m_all[2 * k :]]),
             uA_mr=jnp.concatenate([u_all[:k], u_all[2 * k :]]),
             Ainv_B=Ainv_B,

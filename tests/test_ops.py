@@ -160,3 +160,55 @@ class TestBatchModExpCarry:
         exps = [n1 - 1, (1 << 256) + 1]
         got = batch_modexp(bases, exps, moduli, k)
         assert got == [pow(b, e, n) for b, e, n in zip(bases, exps, moduli)]
+
+
+class TestBatchModInv:
+    def test_tree_inversion_matches_pow(self):
+        import random
+
+        from fsdkr_tpu.ops.limbs import limbs_for_bits
+        from fsdkr_tpu.ops.montgomery import batch_mod_inv_grouped
+
+        rng = random.Random(11)
+        groups = []
+        for bits in (512, 768):
+            for _ in range(3):
+                m = rng.getrandbits(bits) | (1 << (bits - 1)) | 1
+                vs = [rng.getrandbits(bits - 1) | 1 for _ in range(rng.choice([1, 5, 8]))]
+                groups.append((m, vs))
+        k = limbs_for_bits(768)
+        res = batch_mod_inv_grouped(groups, k)
+        import math
+
+        for (m, vs), invs in zip(groups, res):
+            for v, got in zip(vs, invs):
+                if math.gcd(v, m) == 1:
+                    assert got == pow(v, -1, m)
+                else:  # group falls back to host; bad row reports None
+                    assert got is None
+
+    def test_non_invertible_group_falls_back(self):
+        import random
+
+        from fsdkr_tpu.ops.limbs import limbs_for_bits
+        from fsdkr_tpu.ops.montgomery import batch_mod_inv_grouped
+
+        rng = random.Random(12)
+        # modulus divisible by 3; one value shares the factor
+        p = 3
+        m = 0
+        while m % 2 == 0 or m.bit_length() != 512:
+            m = p * (rng.getrandbits(510) | (1 << 509) | 1)
+        good = [rng.getrandbits(500) | 1 for _ in range(3)]
+        good = [g for g in good if __import__("math").gcd(g, m) == 1]
+        vals = good + [p]  # p not invertible mod m
+        m2 = rng.getrandbits(512) | (1 << 511) | 1
+        other = [rng.getrandbits(500) | 1 for _ in range(4)]
+        res = batch_mod_inv_grouped([(m, vals), (m2, other)], limbs_for_bits(512))
+        # poisoned group: per-row fallback, None for the bad row
+        for v, got in zip(vals[:-1], res[0][:-1]):
+            assert got == pow(v, -1, m)
+        assert res[0][-1] is None
+        # healthy group unaffected
+        for v, got in zip(other, res[1]):
+            assert got == pow(v, -1, m2)

@@ -63,21 +63,13 @@ class TestPallasMontMul:
             consts_arrays
         )
 
-        def resplit(lo, hi):
-            ksz = lo.shape[0]
-            return [
-                (lo[s : s + rns._LANE], hi[s : s + rns._LANE], s,
-                 min(rns._LANE, ksz - s))
-                for s in range(0, ksz, rns._LANE)
-            ]
-
         k = rb.k
         xla_consts = dict(
             k=k,
             m_all=m_all,
             u_all=u_all,
-            T1s=resplit(T1l, T1h),
-            T2s=resplit(T2l, T2h),
+            T1s=rns._resplit(T1l, T1h),
+            T2s=rns._resplit(T2l, T2h),
             mA_mr=jnp.concatenate([m_all[:k], m_all[2 * k :]]),
             uA_mr=jnp.concatenate([u_all[:k], u_all[2 * k :]]),
             Ainv_B=Ainv_B,
@@ -91,6 +83,72 @@ class TestPallasMontMul:
 
         from fsdkr_tpu.ops.pallas_rns import rns_mont_mul_pallas
 
+        got = np.asarray(
+            rns_mont_mul_pallas(
+                x, y, c1, n_bmr, rns._pallas_shared(consts_arrays),
+                k=k, interpret=True,
+            )
+        )
+        assert (got == want).all()
+
+    def test_matmul_chunking_4096_class(self):
+        """The 4096-bit width class has k=260 channels — beyond the 2^24
+        full-width f32 exactness bound. The chunked Pallas matmul must
+        still match the XLA chain (regression for the unchunked-dot
+        bug)."""
+        from fsdkr_tpu.ops.limbs import limbs_for_bits
+        from fsdkr_tpu.ops.pallas_rns import rns_mont_mul_pallas
+
+        bits = 4096
+        rb = rns.rns_bases_for_bits(bits, limbs_for_bits(bits))
+        assert rb.k > 257  # the premise of this regression test
+        rows = 8
+        moduli = [
+            secrets.randbits(bits) | (1 << (bits - 1)) | 1 for _ in range(rows)
+        ]
+        c1 = np.zeros((rows, rb.k), np.uint32)
+        n_bmr = np.zeros((rows, rb.k + 1), np.uint32)
+        for r, n in enumerate(moduli):
+            for i, a in enumerate(rb.A_primes):
+                c1[r, i] = (-pow(n, -1, a)) % a * int(rb.Ai_inv[i]) % a
+            for j, b in enumerate(rb.B_primes):
+                n_bmr[r, j] = n % b
+            n_bmr[r, rb.k] = n % rb.m_r
+        c1 = jnp.asarray(c1)
+        n_bmr = jnp.asarray(n_bmr)
+        # worst-case-ish inputs: residues near the channel maxima
+        x = jnp.asarray(
+            np.array(
+                [[int(m) - 1 for m in rb.m_all] for _ in range(rows)], np.uint32
+            )
+        )
+        y = jnp.asarray(
+            np.array(
+                [[int(m) - 2 for m in rb.m_all] for _ in range(rows)], np.uint32
+            )
+        )
+        consts_arrays = _consts_arrays(rb)
+        k = rb.k
+        xla_consts = dict(
+            k=k,
+            m_all=consts_arrays[0],
+            u_all=consts_arrays[1],
+            T1s=rns._resplit(consts_arrays[2], consts_arrays[3]),
+            T2s=rns._resplit(consts_arrays[4], consts_arrays[5]),
+            mA_mr=jnp.concatenate(
+                [consts_arrays[0][:k], consts_arrays[0][2 * k :]]
+            ),
+            uA_mr=jnp.concatenate(
+                [consts_arrays[1][:k], consts_arrays[1][2 * k :]]
+            ),
+            Ainv_B=consts_arrays[6],
+            c2_B=consts_arrays[7],
+            B_mod_A=consts_arrays[8],
+            Binv_r=consts_arrays[9],
+            c1_A=c1,
+            N_Bmr=n_bmr,
+        )
+        want = np.asarray(rns._rns_mont_mul(x, y, xla_consts))
         got = np.asarray(
             rns_mont_mul_pallas(
                 x, y, c1, n_bmr, rns._pallas_shared(consts_arrays),
