@@ -56,6 +56,13 @@ class ProtocolConfig:
         return replace(self, backend=backend)
 
     @property
+    def device_ec(self) -> bool:
+        """Whether EC hot paths (commit-point fan-out, PDL u1 column,
+        pk_vec MSM) run on the accelerator. Single dispatch point for
+        the protocol layer — mirrors get_batch_powm's backend switch."""
+        return self.backend == "tpu"
+
+    @property
     def prime_bits(self) -> int:
         return self.paillier_bits // 2
 

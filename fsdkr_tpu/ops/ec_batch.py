@@ -207,18 +207,33 @@ def points_to_device(points: Sequence[Point]) -> jnp.ndarray:
 
 
 def device_to_points(arr) -> List[Point]:
-    """(B, 3, K) Montgomery-domain projective -> affine host points."""
+    """(B, 3, K) Montgomery-domain projective -> affine host points.
+
+    Z inverses use Montgomery's batch-inversion chain (one pow(-1) for
+    the whole batch): per-row CPython inversion costs ~0.5 ms, which at
+    the n=256 protocol scale (65k points per launch) would be ~30 s of
+    serial host work; the chain is 3B cheap 256-bit multiplications."""
     a = np.asarray(arr)
     b = a.shape[0]
     flat = limbs_to_ints(a.reshape(b * 3, _K))
+    zs = [flat[3 * i + 2] * _R_INV % FIELD_P for i in range(b)]
+    # prefix-product chain, skipping identity rows (z == 0)
+    prefix = [1] * (b + 1)
+    for i, z in enumerate(zs):
+        prefix[i + 1] = prefix[i] * (z or 1) % FIELD_P
+    acc = pow(prefix[b], -1, FIELD_P)
+    zinvs = [0] * b
+    for i in range(b - 1, -1, -1):
+        zinvs[i] = prefix[i] * acc % FIELD_P
+        acc = acc * (zs[i] or 1) % FIELD_P
     out = []
     for i in range(b):
-        x, y, z = (v * _R_INV % FIELD_P for v in flat[3 * i : 3 * i + 3])
-        if z == 0:
+        if zs[i] == 0:
             out.append(Point.identity())
         else:
-            zinv = pow(z, -1, FIELD_P)
-            out.append(Point(x * zinv % FIELD_P, y * zinv % FIELD_P))
+            x = flat[3 * i] * _R_INV % FIELD_P
+            y = flat[3 * i + 1] * _R_INV % FIELD_P
+            out.append(Point(x * zinvs[i] % FIELD_P, y * zinvs[i] % FIELD_P))
     return out
 
 
@@ -253,6 +268,16 @@ def batch_scalar_mul(
         scalar_bits=scalar_bits,
     )
     return device_to_points(out)[:rows]
+
+
+def batch_generator_mul(scalars: Sequence[int]) -> List[Point]:
+    """s_i * G row-wise, one launch — the prover's per-receiver point
+    fan-out (S_i = sigma_i * G, reference refresh_message.rs:67-69) and
+    the PDL prover's u1 column, batched instead of ~2 ms/row host
+    ladders."""
+    from ..core.secp256k1 import GENERATOR
+
+    return batch_scalar_mul([GENERATOR] * len(scalars), scalars)
 
 
 def batch_msm(

@@ -398,6 +398,8 @@ def shared_base_modexp(
         host_ladder = g_cnt <= _HOST_LADDER_MAX_GROUPS
     powers = None
     if host_ladder:
+        from ..core import intops
+
         w_cnt = exp_bits // _WINDOW
         r = 1 << (LIMB_BITS * num_limbs)
         flat_powers: List[int] = []
@@ -405,7 +407,7 @@ def shared_base_modexp(
             p = b % n
             for _ in range(w_cnt):
                 flat_powers.append(p * r % n)  # Montgomery domain
-                p = pow(p, 1 << _WINDOW, n)
+                p = intops.mod_pow(p, 1 << _WINDOW, n)
         powers = jnp.asarray(
             ints_to_limbs(flat_powers, num_limbs)
             .reshape(g_cnt, w_cnt, num_limbs)

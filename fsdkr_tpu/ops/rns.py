@@ -182,6 +182,11 @@ class RNSBases:
         return acc % A
 
 
+# Above this group count the comb's power ladder runs on the device
+# batch (sequential squarings over G rows amortize); below it the host's
+# native modexp chain wins (mirrors montgomery._HOST_LADDER_MAX_GROUPS).
+_DEVICE_LADDER_MIN_GROUPS = 64
+
 _BASES_CACHE: Dict[Tuple[int, int], RNSBases] = {}
 
 
@@ -451,14 +456,17 @@ def _rns_modexp_full_pallas(
     )
 
 
-@partial(jax.jit, static_argnames=("exp_bits", "k", "pallas_mode"))
+@partial(jax.jit, static_argnames=("exp_bits", "k", "pallas_mode", "device_ladder"))
 def _rns_shared_modexp_kernel(
     powers_limbs, exp, a2n_limbs, c1_A, N_Bmr, consts_arrays, *, exp_bits, k,
-    pallas_mode=0,
+    pallas_mode=0, device_ladder=False,
 ):
     """Fixed-base comb over RNS MontMuls: groups share (base, modulus).
 
-    powers_limbs: (W, G, L) limb rows of base^(16^w) mod n (host ladder);
+    powers_limbs: (W, G, L) limb rows of base^(16^w) mod n (host ladder),
+    or — with device_ladder=True — (1, G, L) holding just the bases, the
+    4*W sequential squarings running on the G-row device batch instead
+    (the host ladder costs G*W native modexp steps, seconds at G=256).
     exp: (G, M, EL); a2n_limbs: (G, L); c1_A: (G, k); N_Bmr: (G, k+1).
     Same comb structure as ops.montgomery._shared_modexp_kernel — ladder
     amortized per group, log-depth 16-entry tables, one table multiply
@@ -470,7 +478,11 @@ def _rns_shared_modexp_kernel(
         consts_arrays
     )
 
-    w_cnt, g, L = powers_limbs.shape
+    # w_cnt always follows the (static) exponent width — with the device
+    # ladder, powers_limbs is (1, G, L) and its leading dim is NOT the
+    # window count
+    w_cnt = exp_bits // WINDOW_BITS
+    _, g, L = powers_limbs.shape
     m = exp.shape[1]
     c = 2 * k + 1
 
@@ -505,8 +517,25 @@ def _rns_shared_modexp_kernel(
 
     a2n_res = _limbs_to_residues(a2n_limbs, consts_g)  # (G, C)
     a2n_wg = jnp.broadcast_to(a2n_res[None], (w_cnt, g, c)).reshape(w_cnt * g, c)
-    p_res = _limbs_to_residues(powers_limbs.reshape(w_cnt * g, L), consts_wg)
-    p1 = _rns_mont_mul(p_res, a2n_wg, consts_wg)  # Montgomery domain
+    if device_ladder:
+        # powers_limbs is (1, G, L): just the bases. Build the ladder on
+        # the G-row batch: powers[w] = base_m^(16^w), 4 squarings apart.
+        base_res = _limbs_to_residues(powers_limbs.reshape(g, L), consts_g)
+        base_m = _rns_mont_mul(base_res, a2n_res, consts_g)
+
+        def ladder_step(w, carry):
+            p, pws = carry
+            pws = lax.dynamic_update_index_in_dim(pws, p, w, axis=0)
+            for _ in range(WINDOW_BITS):
+                p = _rns_mont_mul(p, p, consts_g)
+            return p, pws
+
+        powers0 = jnp.zeros((w_cnt, g, c), _U32)
+        _, powers = lax.fori_loop(0, w_cnt, ladder_step, (base_m, powers0))
+        p1 = powers.reshape(w_cnt * g, c)
+    else:
+        p_res = _limbs_to_residues(powers_limbs.reshape(w_cnt * g, L), consts_wg)
+        p1 = _rns_mont_mul(p_res, a2n_wg, consts_wg)  # Montgomery domain
 
     one_g = jnp.ones((g, c), _U32)
     one_m_g = _rns_mont_mul(one_g, a2n_res, consts_g)  # (G, C)
@@ -568,8 +597,9 @@ def rns_modexp_shared(
 ) -> List[List[int]]:
     """Fixed-base comb through the RNS/MXU pipeline:
     bases[g]^exps[g][m] mod moduli[g]. The per-group power ladder runs on
-    the host (one pow(p, 16, n) chain per group); rows pad with exponent
-    0. Moduli sharing a factor with a channel prime fall back per group."""
+    the host (native modexp chain) for small group counts, on the device
+    batch above _DEVICE_LADDER_MIN_GROUPS; rows pad with exponent 0.
+    Moduli sharing a factor with a channel prime fall back per group."""
     g_cnt = len(bases_int)
     if g_cnt == 0:
         return []
@@ -608,19 +638,30 @@ def rns_modexp_shared(
         n_bmr[r, k] = moduli[r] % rb.m_r
         a2n.append(pow(rb.A, 2, moduli[r]))
 
-    # host power ladder, Montgomery-free (plain residue inputs; the kernel
-    # converts and enters the Montgomery domain itself)
-    flat_powers: List[int] = []
-    for b, n in zip(work_bases, moduli):
-        p = b % n
-        for _ in range(w_cnt):
-            flat_powers.append(p)
-            p = pow(p, 1 << WINDOW_BITS, n)
-    powers_limbs = (
-        ints_to_limbs(flat_powers, num_limbs)
-        .reshape(g_cnt, w_cnt, num_limbs)
-        .transpose(1, 0, 2)
-    )
+    device_ladder = g_cnt > _DEVICE_LADDER_MIN_GROUPS
+    if device_ladder:
+        # bases only; the kernel runs the 4*W sequential squarings on the
+        # G-row device batch (host chain would be G*W native modexps)
+        powers_limbs = ints_to_limbs(work_bases, num_limbs).reshape(
+            1, g_cnt, num_limbs
+        )
+    else:
+        # host power ladder, Montgomery-free (plain residue inputs; the
+        # kernel converts and enters the Montgomery domain itself);
+        # squarings ride the native C++ core via intops.mod_pow
+        from ..core import intops
+
+        flat_powers: List[int] = []
+        for b, n in zip(work_bases, moduli):
+            p = b % n
+            for _ in range(w_cnt):
+                flat_powers.append(p)
+                p = intops.mod_pow(p, 1 << WINDOW_BITS, n)
+        powers_limbs = (
+            ints_to_limbs(flat_powers, num_limbs)
+            .reshape(g_cnt, w_cnt, num_limbs)
+            .transpose(1, 0, 2)
+        )
 
     flat_exps: List[int] = []
     for grp in exps_per_group:
@@ -638,12 +679,16 @@ def rns_modexp_shared(
     if mesh is not None and g_cnt % int(mesh.devices.size) == 0:
         from ..parallel.shard_kernels import sharded_rns_shared_modexp_fn
 
-        out_res = sharded_rns_shared_modexp_fn(mesh, exp_bits, k, _pallas_mode())(
-            *args
-        )
+        out_res = sharded_rns_shared_modexp_fn(
+            mesh, exp_bits, k, _pallas_mode(), device_ladder
+        )(*args)
     else:
         out_res = _rns_shared_modexp_kernel(
-            *args, exp_bits=exp_bits, k=k, pallas_mode=_pallas_mode()
+            *args,
+            exp_bits=exp_bits,
+            k=k,
+            pallas_mode=_pallas_mode(),
+            device_ladder=device_ladder,
         )
     res = np.asarray(out_res).reshape(g_cnt, m_max, 2 * k + 1)
 

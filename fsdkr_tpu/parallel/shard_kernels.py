@@ -145,7 +145,9 @@ def sharded_rns_modexp_fn(mesh, exp_bits: int, k: int, pallas_mode: int = 0):
 
 
 @lru_cache(maxsize=128)
-def sharded_rns_shared_modexp_fn(mesh, exp_bits: int, k: int, pallas_mode: int = 0):
+def sharded_rns_shared_modexp_fn(
+    mesh, exp_bits: int, k: int, pallas_mode: int = 0, device_ladder: bool = False
+):
     """RNS comb sharded over groups. The kernel returns (G*M, C) rows in
     group-major order, so a leading-axis shard over G devices concatenates
     back in the right order."""
@@ -157,6 +159,7 @@ def sharded_rns_shared_modexp_fn(mesh, exp_bits: int, k: int, pallas_mode: int =
         exp_bits=exp_bits,
         k=k,
         pallas_mode=pallas_mode,
+        device_ladder=device_ladder,
     )
     sm = jax.shard_map(
         kernel,

@@ -114,6 +114,7 @@ class PDLwSlackProof:
         witnesses: list[PDLwSlackWitness],
         statements: list[PDLwSlackStatement],
         powm=None,
+        device_ec: bool = False,
     ) -> list["PDLwSlackProof"]:
         """Batched prover: the n-receiver fan-out of distribute (reference
         `/root/reference/src/refresh_message.rs:87-104`) as modexp columns
@@ -146,7 +147,14 @@ class PDLwSlackProof:
             h1v, h2v, ntv,
             [w.x.to_int() for w in witnesses], rho, alpha, gamma, powm,
         )
-        u1 = [st.G * Scalar.from_int(al) for st, al in zip(statements, alpha)]
+        from ..core.secp256k1 import GENERATOR
+
+        if device_ec and all(st.G == GENERATOR for st in statements):
+            from ..ops.ec_batch import batch_generator_mul
+
+            u1 = batch_generator_mul(alpha)
+        else:
+            u1 = [st.G * Scalar.from_int(al) for st, al in zip(statements, alpha)]
         bn = powm(beta, nv, nnv)
         u2 = [(1 + (al % n) * n) * x % nn for al, n, nn, x in zip(alpha, nv, nnv, bn)]
 

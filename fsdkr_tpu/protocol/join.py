@@ -129,7 +129,10 @@ class JoinMessage:
 
         from .refresh import combine_committed_points
 
-        pk_vec = combine_committed_points(refresh_messages, li_vec, t, n)
+        pk_vec = combine_committed_points(
+            refresh_messages, li_vec, t, n,
+            use_device=config.device_ec,
+        )
 
         # same consistency gate as refresh collect: the decrypted share must
         # match the committed public share

@@ -94,3 +94,24 @@ class TestModexp:
         b, e = random.getrandbits(2048) % n, random.getrandbits(2048)
         (got,) = rns_modexp([b], [e], [n], 2048)
         assert got == pow(b, e, n)
+
+
+def test_shared_comb_device_ladder(monkeypatch):
+    """Above _DEVICE_LADDER_MIN_GROUPS the comb builds its power ladder
+    on the device batch; results must match the host-ladder path / pow."""
+    import random
+
+    from fsdkr_tpu.ops import rns
+
+    rng = random.Random(21)
+    bits = 512
+    monkeypatch.setattr(rns, "_DEVICE_LADDER_MIN_GROUPS", 2)
+    gmods = [rng.getrandbits(bits) | (1 << (bits - 1)) | 1 for _ in range(4)]
+    gbases = [rng.getrandbits(bits - 1) for _ in range(4)]
+    gexps = [[rng.getrandbits(96) for _ in range(3)] for _ in range(4)]
+    got = rns.rns_modexp_shared(gbases, gexps, gmods, bits)
+    want = [
+        [pow(b % n, e, n) for e in grp]
+        for b, grp, n in zip(gbases, gexps, gmods)
+    ]
+    assert got == want
