@@ -55,6 +55,14 @@ def init_jax_with_retry(attempts=4, delay=15.0):
         jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
     except Exception:
         pass
+    # BENCH_PLATFORM=cpu runs the bench flow off-chip (smoke-testing the
+    # harness; the axon plugin ignores JAX_PLATFORMS, hence jax.config)
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
 
     last = None
     for i in range(attempts):
@@ -137,12 +145,80 @@ def bench_sessions(sessions_count, n, t, bits, m_sec):
     )
 
 
+def bench_join(n, t, bits, m_sec, joins):
+    """Config-3 shape (BASELINE.json): join/replace at (n, t) — ring-
+    Pedersen + PDL batches plus the join-side correct-key/composite-dlog
+    verifies, timed at one existing party's collect."""
+    from fsdkr_tpu.config import ProtocolConfig
+    from fsdkr_tpu.protocol import JoinMessage, RefreshMessage, simulate_keygen
+
+    cfg = ProtocolConfig(paillier_bits=bits, m_security=m_sec)
+    tpu_cfg = cfg.with_backend("tpu")
+    n_existing = n - joins
+    # the flow needs >= 2 existing parties (cold + warm collect use two
+    # different collectors) and a valid (t, n_existing) Shamir setup
+    if n_existing < max(t + 1, 2):
+        raise ValueError(
+            f"BENCH_JOIN={joins} leaves {n_existing} existing parties; "
+            f"need at least max(t+1, 2) = {max(t + 1, 2)} for n={n}, t={t}"
+        )
+
+    log(f"join/replace setup: n={n} t={t} joins={joins} bits={bits} M={m_sec} ...")
+    t0 = time.time()
+    keys = simulate_keygen(t, n_existing, cfg)
+    join_messages = []
+    for idx in range(n_existing + 1, n + 1):
+        jm, _pair = JoinMessage.distribute(cfg)
+        jm.set_party_index(idx)
+        join_messages.append(jm)
+    t_keygen = time.time() - t0
+
+    t0 = time.time()
+    ident = {i: i for i in range(1, n_existing + 1)}
+    msgs, dks = [], []
+    for key in keys:
+        m, dk = RefreshMessage.replace(join_messages, key, ident, n, tpu_cfg)
+        msgs.append(m)
+        dks.append(dk)
+    t_replace = time.time() - t0
+    log(f"setup done: keygen+join {t_keygen:.1f}s, replace(distribute) {t_replace:.1f}s")
+
+    # per-collect proof instances: PDL+range over existing msgs x n slots,
+    # ring-Pedersen + correct-key for refresh and join senders, 2 dlog
+    # proofs per join
+    proofs = 2 * n_existing * n + 2 * (n_existing + joins) + 2 * joins
+
+    t0 = time.time()
+    RefreshMessage.collect(msgs, keys[0].clone(), dks[0], join_messages, tpu_cfg)
+    t_cold = time.time() - t0
+    log(f"join collect cold: {t_cold:.2f}s")
+    t0 = time.time()
+    RefreshMessage.collect(msgs, keys[1].clone(), dks[1], join_messages, tpu_cfg)
+    t_warm = time.time() - t0
+    log(f"join collect warm: {t_warm:.2f}s -> {proofs / t_warm:.1f} proofs/s")
+    emit(
+        {
+            "metric": (
+                f"join/replace collect throughput @ n={n},t={t},"
+                f"{joins} joins,{bits}-bit (config 3)"
+            ),
+            "value": round(proofs / t_warm, 2),
+            "unit": "proofs/s",
+            "vs_baseline": 0,
+            "collect_warm_s": round(t_warm, 2),
+            "collect_cold_s": round(t_cold, 2),
+            "replace_s": round(t_replace, 2),
+        }
+    )
+
+
 def main():
     n = int(os.environ.get("BENCH_N", "16"))
     t = int(os.environ.get("BENCH_T", "8"))
     bits = int(os.environ.get("BENCH_BITS", "2048"))
     m_sec = int(os.environ.get("BENCH_M", "256"))
     sessions_count = int(os.environ.get("BENCH_SESSIONS", "1"))
+    joins = int(os.environ.get("BENCH_JOIN", "0"))
 
     jax, _ = init_jax_with_retry()
 
@@ -151,6 +227,9 @@ def main():
 
     if sessions_count > 1:
         bench_sessions(sessions_count, n, t, bits, m_sec)
+        return
+    if joins > 0:
+        bench_join(n, t, bits, m_sec, joins)
         return
 
     cfg = ProtocolConfig(paillier_bits=bits, m_security=m_sec)

@@ -68,18 +68,27 @@ class VerifiableSS:
         return acc
 
 
-def share(t: int, n: int, secret: Scalar) -> tuple[VerifiableSS, List[Scalar]]:
-    """Sample a degree-t polynomial with f(0)=secret; return commitments to
-    its coefficients and the n shares f(1..n)
-    (reference call site `/root/reference/src/refresh_message.rs:62`)."""
+def sample_poly(t: int, n: int, secret: Scalar) -> tuple[List[Scalar], List[Scalar]]:
+    """Sample a degree-t polynomial with f(0)=secret; return (coefficients,
+    shares f(1..n)). Commitment to the coefficients is a separate step so
+    many senders' coefficient columns can share one batched EC launch
+    (fsdkr_tpu.ops.ec_batch.batch_generator_mul)."""
     coeffs = [secret] + [Scalar(secrets.randbelow(N)) for _ in range(t)]
-    commitments = [GENERATOR * c for c in coeffs]
     shares = []
     for i in range(1, n + 1):
         acc = 0
         for c in reversed(coeffs):
             acc = (acc * i + c.v) % N
         shares.append(Scalar(acc))
+    return coeffs, shares
+
+
+def share(t: int, n: int, secret: Scalar) -> tuple[VerifiableSS, List[Scalar]]:
+    """Sample a degree-t polynomial with f(0)=secret; return commitments to
+    its coefficients and the n shares f(1..n)
+    (reference call site `/root/reference/src/refresh_message.rs:62`)."""
+    coeffs, shares = sample_poly(t, n, secret)
+    commitments = [GENERATOR * c for c in coeffs]
     return VerifiableSS(ShamirSecretSharing(t, n), commitments), shares
 
 

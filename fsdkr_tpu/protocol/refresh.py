@@ -119,10 +119,9 @@ class RefreshMessage:
 
         per = []  # per-sender working state, in input order
         for old_party_index, local_key in senders:
-            scheme, secret_shares = vss.share(
+            coeffs, secret_shares = vss.sample_poly(
                 local_key.t, new_n, local_key.keys_linear.x_i
             )
-            local_key.vss_scheme = scheme
             receiver_eks = [local_key.paillier_key_vec[i] for i in range(new_n)]
             randomness_vec = [
                 paillier.sample_randomness(ek_i) for ek_i in receiver_eks
@@ -131,12 +130,39 @@ class RefreshMessage:
                 dict(
                     old_i=old_party_index,
                     key=local_key,
-                    scheme=scheme,
+                    coeffs=coeffs,
                     shares=secret_shares,
                     eks=receiver_eks,
                     rand=randomness_vec,
                 )
             )
+
+        # Feldman coefficient commitments A_k = a_k * G, all senders in one
+        # device launch on the TPU backend (t+1 host ladders per sender
+        # otherwise — ~66 s at n=256)
+        if config.device_ec:
+            from ..ops.ec_batch import batch_generator_mul
+
+            flat_coeff_points = batch_generator_mul(
+                [c.to_int() for p in per for c in p["coeffs"]]
+            )
+            pos = 0
+            for p in per:
+                cnt = len(p["coeffs"])
+                commitments = flat_coeff_points[pos : pos + cnt]
+                pos += cnt
+                p["scheme"] = vss.VerifiableSS(
+                    vss.ShamirSecretSharing(p["key"].t, new_n), commitments
+                )
+        else:
+            for p in per:
+                p["scheme"] = vss.VerifiableSS(
+                    vss.ShamirSecretSharing(p["key"].t, new_n),
+                    [GENERATOR * c for c in p["coeffs"]],
+                )
+        for p in per:
+            del p["coeffs"]  # polynomial coefficients are secret round state
+            p["key"].vss_scheme = p["scheme"]
 
         from ..utils.trace import phase
 
