@@ -260,10 +260,15 @@ def main():
     t_tpu_cold = time.time() - t0
     log(f"tpu collect cold: {t_tpu_cold:.2f}s")
 
+    from fsdkr_tpu.utils.trace import get_tracer
+
+    get_tracer().reset()
     t0 = time.time()
     RefreshMessage.collect(msgs, keys[1].clone(), dks[1], (), tpu_cfg)
     t_tpu = time.time() - t0
     log(f"tpu collect warm: {t_tpu:.2f}s -> {proofs / t_tpu:.1f} proofs/s")
+    if get_tracer().enabled:  # FSDKR_TRACE=1: per-family breakdown
+        log(get_tracer().report())
 
     # --- host baseline on a subsample (serial loop; linear extrapolation)
     # Two baselines: the native C++ Montgomery path (intops.mod_pow routes

@@ -112,16 +112,30 @@ import os as _os
 
 _RNS_MIN_ROWS = int(_os.environ.get("FSDKR_RNS_MIN_ROWS", "512"))
 
+# HBM ceiling: the modexp kernels materialize a 16-entry window table
+# over the whole batch (generic: 16*R rows; comb: 16*W*G rows with
+# W = exp_bits/4 windows). At the n=256 collect shape an unchunked
+# launch would need a multi-GB (comb: multi-TB) table, so batches are
+# tiled: generic launches at most _MAX_ROWS rows, comb launches at most
+# _MAX_ROWS table rows (w_cnt * group-chunk), sequential tiles.
+_MAX_ROWS = int(_os.environ.get("FSDKR_MAX_ROWS_PER_LAUNCH", "16384"))
+
 # modulus width classes with prepared RNS bases (caps distinct compiled
 # kernel shapes; moduli bucket up to the nearest class)
 _RNS_WIDTH_CLASSES = (256, 512, 1024, 1536, 2048, 3072, 4096)
 
 
 def tpu_powm(bases, exps, moduli) -> List[int]:
-    from ..ops.limbs import limbs_for_bits
-
     if not bases:
         return []
+    if len(bases) > _MAX_ROWS:  # HBM tiling: sequential launches
+        out: List[int] = []
+        for lo in range(0, len(bases), _MAX_ROWS):
+            hi = lo + _MAX_ROWS
+            out += tpu_powm(bases[lo:hi], exps[lo:hi], moduli[lo:hi])
+        return out
+    from ..ops.limbs import limbs_for_bits
+
     b = len(bases)
     pad = _pad_pow2(b) - b
     bases = list(bases) + [1] * pad
@@ -145,21 +159,55 @@ def tpu_powm_shared(bases, exps_per_group, moduli) -> List[List[int]]:
 
     Group count and rows-per-group are padded to powers of two (dummy
     groups use modulus 3, dummy rows exponent 0) so compiled kernel shapes
-    are reused across committee sizes.
+    are reused across committee sizes. Launches tile so the comb's
+    16 * w_cnt * G-row window table stays under the HBM cap.
     """
-    from ..ops.limbs import limbs_for_bits
+    from ..ops.limbs import WINDOW_BITS, bucket_exp_bits, limbs_for_bits
     from ..ops.montgomery import shared_base_modexp
 
     if not bases:
         return []
+    w_cnt = max(
+        1,
+        bucket_exp_bits([e for grp in exps_per_group for e in grp])
+        // WINDOW_BITS,
+    )
+    m_max = max((len(e) for e in exps_per_group), default=1) or 1
+    m_pad = max(8, 1 << (m_max - 1).bit_length())
+    # The RNS comb builds window tables on the fly, so its footprint is
+    # the (w_cnt, G) power ladder and the (G*M) accumulator — budget
+    # 16*_MAX_ROWS rows for each. The CIOS comb (small batches only)
+    # still materializes (16, w_cnt, G) tables — budget _MAX_ROWS.
+    rns_path = len(bases) * m_max >= _RNS_MIN_ROWS
+    budget = (16 * _MAX_ROWS) if rns_path else _MAX_ROWS
+    if m_pad > budget:  # huge per-group row counts: tile the row axis
+        parts = []
+        for lo in range(0, m_max, budget):
+            parts.append(
+                tpu_powm_shared(
+                    bases, [e[lo : lo + budget] for e in exps_per_group], moduli
+                )
+            )
+        return [
+            [v for part in parts for v in part[i]] for i in range(len(bases))
+        ]
+    g_cap = max(
+        1, 1 << max(0, min(budget // w_cnt, budget // m_pad).bit_length() - 1)
+    )
+    if len(bases) > g_cap:  # HBM tiling over group chunks
+        out: List[List[int]] = []
+        for lo in range(0, len(bases), g_cap):
+            hi = lo + g_cap
+            out += tpu_powm_shared(
+                bases[lo:hi], exps_per_group[lo:hi], moduli[lo:hi]
+            )
+        return out
     g = len(bases)
     g_pad = max(2, 1 << (g - 1).bit_length())
     if _MESH is not None:
         from ..parallel.shard_kernels import padded_rows
 
         g_pad = padded_rows(g_pad, _MESH)
-    m_max = max((len(e) for e in exps_per_group), default=1) or 1
-    m_pad = max(8, 1 << (m_max - 1).bit_length())
     bases = list(bases) + [1] * (g_pad - g)
     moduli = list(moduli) + [3] * (g_pad - g)
     exps = [list(e) + [0] * (m_pad - len(e)) for e in exps_per_group]

@@ -178,16 +178,6 @@ class RNSBases:
             np.uint32,
         )  # (num_limbs, 2k+1)
 
-    # -- host-side CRT (exit path) ---------------------------------------
-    def residues_to_int(self, xi_row: Sequence[int]) -> int:
-        """Exact value from A-channel *CRT coefficients* xi (already
-        multiplied by (A/a_i)^{-1} on device): v = sum xi_i * A/a_i mod A."""
-        acc = 0
-        A = self.A
-        for i, x in enumerate(xi_row):
-            acc += (A // self.A_primes[i]) * int(x)
-        return acc % A
-
     # -- device CRT-exit constants (built lazily: only the exit needs them)
     @property
     def exit_consts(self):
@@ -618,17 +608,21 @@ def _rns_shared_modexp_kernel(
             pallas_interpret=pallas_mode == 2,
         )
 
-    # group consts broadcast to the three batch layouts used below
+    # group consts broadcast to the batch layouts used below
     consts_g = consts_for(c1_A, N_Bmr)
-    c1_wg = jnp.broadcast_to(c1_A[None], (w_cnt, g, k)).reshape(w_cnt * g, k)
-    n_wg = jnp.broadcast_to(N_Bmr[None], (w_cnt, g, k + 1)).reshape(w_cnt * g, k + 1)
-    consts_wg = consts_for(c1_wg, n_wg)
     c1_gm = jnp.broadcast_to(c1_A[:, None], (g, m, k)).reshape(g * m, k)
     n_gm = jnp.broadcast_to(N_Bmr[:, None], (g, m, k + 1)).reshape(g * m, k + 1)
     consts_gm = consts_for(c1_gm, n_gm)
 
+    def consts_rep(times):
+        return consts_for(
+            jnp.concatenate([c1_A] * times, axis=0),
+            jnp.concatenate([N_Bmr] * times, axis=0),
+        )
+
+    consts_2g, consts_4g, consts_7g = consts_rep(2), consts_rep(4), consts_rep(7)
+
     a2n_res = _limbs_to_residues(a2n_limbs, consts_g)  # (G, C)
-    a2n_wg = jnp.broadcast_to(a2n_res[None], (w_cnt, g, c)).reshape(w_cnt * g, c)
     if device_ladder:
         # powers_limbs is (1, G, L): just the bases. Build the ladder on
         # the G-row batch: powers[w] = base_m^(16^w), 4 squarings apart.
@@ -644,38 +638,48 @@ def _rns_shared_modexp_kernel(
 
         powers0 = jnp.zeros((w_cnt, g, c), _U32)
         _, powers = lax.fori_loop(0, w_cnt, ladder_step, (base_m, powers0))
-        p1 = powers.reshape(w_cnt * g, c)
     else:
+        c1_wg = jnp.broadcast_to(c1_A[None], (w_cnt, g, k)).reshape(w_cnt * g, k)
+        n_wg = jnp.broadcast_to(
+            N_Bmr[None], (w_cnt, g, k + 1)
+        ).reshape(w_cnt * g, k + 1)
+        consts_wg = consts_for(c1_wg, n_wg)
+        a2n_wg = jnp.broadcast_to(
+            a2n_res[None], (w_cnt, g, c)
+        ).reshape(w_cnt * g, c)
         p_res = _limbs_to_residues(powers_limbs.reshape(w_cnt * g, L), consts_wg)
-        p1 = _rns_mont_mul(p_res, a2n_wg, consts_wg)  # Montgomery domain
+        powers = _rns_mont_mul(p_res, a2n_wg, consts_wg).reshape(w_cnt, g, c)
 
     one_g = jnp.ones((g, c), _U32)
     one_m_g = _rns_mont_mul(one_g, a2n_res, consts_g)  # (G, C)
-    one_m_wg = jnp.broadcast_to(one_m_g[None], (w_cnt, g, c)).reshape(w_cnt * g, c)
 
-    def mul_many(pairs):
-        a = jnp.concatenate([x for x, _ in pairs], axis=0)
-        b = jnp.concatenate([y for _, y in pairs], axis=0)
-        cc = consts_for(
-            jnp.concatenate([c1_wg] * len(pairs), axis=0),
-            jnp.concatenate([n_wg] * len(pairs), axis=0),
+    # Per-window 16-entry tables are built ON THE FLY inside the window
+    # loop from powers[w] (log-depth products on G-row batches): a
+    # materialized all-windows table is (16, W, G, C) — terabytes at the
+    # n=256 ring-Pedersen shape — while the fly-built one is (16, G, C)
+    # live at a time, and the extra ~14 G-row products per window are
+    # ~5% of the (G*M)-row accumulation work.
+    def window_table(p1):
+        def mul_many(pairs, cc):
+            a = jnp.concatenate([x for x, _ in pairs], axis=0)
+            b = jnp.concatenate([y for _, y in pairs], axis=0)
+            out = _rns_mont_mul(a, b, cc)
+            return [out[i * g : (i + 1) * g] for i in range(len(pairs))]
+
+        p2 = _rns_mont_mul(p1, p1, consts_g)
+        p3, p4 = mul_many([(p2, p1), (p2, p2)], consts_2g)
+        p5, p6, p7, p8 = mul_many(
+            [(p4, p1), (p4, p2), (p4, p3), (p4, p4)], consts_4g
         )
-        out = _rns_mont_mul(a, b, cc)
-        return [
-            out[i * w_cnt * g : (i + 1) * w_cnt * g] for i in range(len(pairs))
-        ]
-
-    p2 = _rns_mont_mul(p1, p1, consts_wg)
-    p3, p4 = mul_many([(p2, p1), (p2, p2)])
-    p5, p6, p7, p8 = mul_many([(p4, p1), (p4, p2), (p4, p3), (p4, p4)])
-    p9, p10, p11, p12, p13, p14, p15 = mul_many(
-        [(p8, p1), (p8, p2), (p8, p3), (p8, p4), (p8, p5), (p8, p6), (p8, p7)]
-    )
-    table = jnp.stack(
-        [t.reshape(w_cnt, g, c) for t in
-         (one_m_wg, p1, p2, p3, p4, p5, p6, p7, p8, p9, p10, p11, p12, p13, p14, p15)],
-        axis=0,
-    )  # (16, W, G, C)
+        p9, p10, p11, p12, p13, p14, p15 = mul_many(
+            [(p8, p1), (p8, p2), (p8, p3), (p8, p4), (p8, p5), (p8, p6), (p8, p7)],
+            consts_7g,
+        )
+        return jnp.stack(
+            [one_m_g, p1, p2, p3, p4, p5, p6, p7, p8,
+             p9, p10, p11, p12, p13, p14, p15],
+            axis=0,
+        )  # (16, G, C)
 
     acc0 = jnp.broadcast_to(one_m_g[:, None], (g, m, c)).reshape(g * m, c)
     idx = jnp.arange(1 << WINDOW_BITS, dtype=_U32)[:, None, None, None]
@@ -686,7 +690,9 @@ def _rns_shared_modexp_kernel(
             exp, shift // LIMB_BITS, axis=2, keepdims=False
         )  # (G, M)
         d = (limb >> (shift % LIMB_BITS)) & ((1 << WINDOW_BITS) - 1)
-        entries = lax.dynamic_index_in_dim(table, w, axis=1, keepdims=False)
+        entries = window_table(
+            lax.dynamic_index_in_dim(powers, w, axis=0, keepdims=False)
+        )  # (16, G, C)
         sel = jnp.sum(
             jnp.where(
                 d[None, :, :, None] == idx, entries[:, :, None, :], jnp.uint32(0)

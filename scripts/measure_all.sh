@@ -1,0 +1,20 @@
+#!/bin/bash
+# On-chip measurement battery: run as soon as the TPU tunnel is up.
+# Produces /tmp/m_*.json + logs; each step tolerates failure.
+cd /root/repo
+R=/tmp
+run() {  # name, timeout, [VAR=V ...] cmd args...   (no '--': env treats
+  # everything up to the first non-assignment word as the command)
+  name=$1; to=$2; shift 2
+  echo "=== $name ($(date +%H:%M:%S)) ==="
+  timeout "$to" env "$@" > "$R/m_$name.json" 2> "$R/m_$name.log"
+  echo "rc=$? tail:"; tail -3 "$R/m_$name.log"; cat "$R/m_$name.json"
+}
+run sweep_quick 2400 python scripts/bench_kernels.py quick
+run n16 2400 FSDKR_TRACE=1 python bench.py
+run join32 2400 BENCH_N=32 BENCH_T=15 BENCH_JOIN=2 python bench.py
+run n64 3000 BENCH_N=64 BENCH_T=32 FSDKR_TRACE=1 python bench.py
+run n128 4800 BENCH_N=128 BENCH_T=64 FSDKR_TRACE=1 python bench.py
+run n256 9000 BENCH_N=256 BENCH_T=128 FSDKR_TRACE=1 python bench.py
+run sessions16 4800 BENCH_SESSIONS=16 BENCH_N=16 BENCH_T=8 python bench.py
+echo "=== battery done ==="

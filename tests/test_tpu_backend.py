@@ -166,3 +166,28 @@ class TestCollectOnTpuBackend:
         bad[2].points_encrypted_vec[1] += 1
         with pytest.raises(FsDkrError):
             RefreshMessage.collect(bad, keys[1], dks[1], (), TPU_CFG)
+
+
+def test_launch_tiling_matches_unchunked(monkeypatch):
+    """HBM tiling: chunked launches (FSDKR_MAX_ROWS_PER_LAUNCH) must be
+    row-for-row identical to one launch."""
+    import random
+
+    from fsdkr_tpu.backend import powm
+
+    rng = random.Random(31)
+    bits = 512
+    mods = [rng.getrandbits(bits) | (1 << (bits - 1)) | 1 for _ in range(6)]
+    bases, exps, moduli = [], [], []
+    for b_, m_ in zip([rng.getrandbits(bits - 1) for _ in range(6)], mods):
+        for _ in range(8):
+            bases.append(b_)
+            exps.append(rng.getrandbits(128))
+            moduli.append(m_)
+    want = powm.tpu_powm_grouped(bases, exps, moduli)
+
+    monkeypatch.setattr(powm, "_MAX_ROWS", 16)
+    got = powm.tpu_powm_grouped(bases, exps, moduli)
+    assert got == want
+    got_gen = powm.tpu_powm(bases, exps, moduli)
+    assert got_gen == [pow(b % m, e, m) for b, e, m in zip(bases, exps, moduli)]
