@@ -169,23 +169,32 @@ def tpu_powm_shared(bases, exps_per_group, moduli) -> List[List[int]]:
         return []
     w_cnt = max(
         1,
-        bucket_exp_bits([e for grp in exps_per_group for e in grp])
+        bucket_exp_bits(e for grp in exps_per_group for e in grp)
         // WINDOW_BITS,
     )
     m_max = max((len(e) for e in exps_per_group), default=1) or 1
     m_pad = max(8, 1 << (m_max - 1).bit_length())
+    width = max(m.bit_length() for m in moduli)
     # The RNS comb builds window tables on the fly, so its footprint is
     # the (w_cnt, G) power ladder and the (G*M) accumulator — budget
-    # 16*_MAX_ROWS rows for each. The CIOS comb (small batches only)
-    # still materializes (16, w_cnt, G) tables — budget _MAX_ROWS.
-    rns_path = len(bases) * m_max >= _RNS_MIN_ROWS
+    # 16*_MAX_ROWS rows for each. The CIOS comb — small batches, and any
+    # modulus wider than the largest prepared RNS class — materializes
+    # (16, w_cnt, G) tables: budget _MAX_ROWS.
+    rns_path = (
+        len(bases) * m_max >= _RNS_MIN_ROWS and width <= _RNS_WIDTH_CLASSES[-1]
+    )
     budget = (16 * _MAX_ROWS) if rns_path else _MAX_ROWS
-    if m_pad > budget:  # huge per-group row counts: tile the row axis
+    # power-of-two chunk sizes: a full chunk's padded size equals the
+    # chunk, so tiling terminates for any FSDKR_MAX_ROWS_PER_LAUNCH value
+    row_chunk = max(8, 1 << (budget.bit_length() - 1))
+    if m_pad > row_chunk:  # huge per-group row counts: tile the row axis
         parts = []
-        for lo in range(0, m_max, budget):
+        for lo in range(0, m_max, row_chunk):
             parts.append(
                 tpu_powm_shared(
-                    bases, [e[lo : lo + budget] for e in exps_per_group], moduli
+                    bases,
+                    [e[lo : lo + row_chunk] for e in exps_per_group],
+                    moduli,
                 )
             )
         return [

@@ -87,6 +87,44 @@ class TestMeshedProtocol:
 
         assert GENERATOR * keys[0].keys_linear.x_i == keys[0].keys_linear.y
 
+    def test_collect_sessions_with_joins(self, test_config):
+        """Fused sessions where one session carries join messages: the
+        per-session ck/dlog span bookkeeping must attribute join-side
+        verdicts to the right session."""
+        from fsdkr_tpu.protocol import (
+            JoinMessage,
+            RefreshMessage,
+            simulate_keygen,
+        )
+
+        t, n = 1, 3
+        cfg = test_config
+
+        # session 0: plain refresh
+        keys0 = simulate_keygen(t, n, cfg)
+        res0 = RefreshMessage.distribute_batch([(k.i, k) for k in keys0], n, cfg)
+
+        # session 1: 2 existing parties + 1 join at index 3
+        keys1 = simulate_keygen(t, n, cfg)
+        keys1 = [k for k in keys1 if k.i != 3]
+        jm, _pair = JoinMessage.distribute(cfg)
+        jm.set_party_index(3)
+        ident = {1: 1, 2: 2}
+        res1 = [
+            RefreshMessage.replace([jm], k, ident, n, cfg) for k in keys1
+        ]
+
+        errs = RefreshMessage.collect_sessions(
+            [
+                ([m for m, _ in res0], keys0[0], res0[0][1], ()),
+                ([m for m, _ in res1], keys1[0], res1[0][1], (jm,)),
+            ],
+            cfg,
+        )
+        assert errs == [None, None], errs
+        # join session adopted the joining party's ek
+        assert keys1[0].paillier_key_vec[2] == jm.ek
+
     def test_collect_sessions_fused(self, test_config):
         """Two independent sessions through one fused launch set; a
         tampered session fails alone (identifiable abort preserved)."""
