@@ -11,5 +11,6 @@ scale-out is a reshape, not a new mechanism.
 
 from .mesh import make_mesh
 from .sharded_verify import sharded_modexp, sharded_verdict_step
+from . import multihost
 
-__all__ = ["make_mesh", "sharded_modexp", "sharded_verdict_step"]
+__all__ = ["make_mesh", "multihost", "sharded_modexp", "sharded_verdict_step"]

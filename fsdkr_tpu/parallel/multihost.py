@@ -1,0 +1,102 @@
+"""Multi-host (multi-slice / DCN) initialization.
+
+SURVEY.md §5: the compute fabric is JAX collectives over ICI within a
+slice; DCN enters only for multi-slice scale-out of independent
+sessions (BASELINE config 5 grown past one v5e-8). The protocol needs
+no cross-chip communication beyond verdict reductions, so multi-host
+setup is exactly jax.distributed initialization + a global mesh whose
+outer axis spans hosts (data-parallel over sessions, DCN) and whose
+inner axis spans each host's local chips (proof rows, ICI).
+
+Usage on each host of a multi-host deployment:
+
+    from fsdkr_tpu.parallel import multihost
+    multihost.initialize()            # no-op on a single host
+    mesh = multihost.global_mesh()    # ("session", "batch") mesh
+    config = ProtocolConfig(backend="tpu",
+                            mesh_shape=tuple(mesh.devices.shape))
+
+Process layout follows JAX's standard env detection (coordinator
+address, process count/index from the cluster environment); explicit
+arguments override it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+__all__ = ["initialize", "global_mesh", "is_multihost"]
+
+_initialized = False
+
+# launcher environments whose presence means jax.distributed's own
+# auto-detection can resolve the process layout
+_CLUSTER_MARKERS = (
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",  # multi-slice
+    "TPU_WORKER_HOSTNAMES",  # GKE / TPU jobsets
+    "SLURM_JOB_ID",
+)
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bring up jax.distributed when running multi-process; harmless
+    single-host no-op. Idempotent for detection-based calls; explicit
+    arguments always reach jax.distributed (which itself rejects a
+    second, conflicting initialization). An initialization done by
+    other code is treated as success."""
+    global _initialized
+    explicit = (
+        coordinator_address is not None
+        or num_processes is not None
+        or process_id is not None
+    )
+    if _initialized and not explicit:
+        return
+    if not explicit and not any(os.environ.get(m) for m in _CLUSTER_MARKERS):
+        _initialized = True  # single host: nothing to bring up
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        if "already" not in str(e):  # initialized elsewhere == success
+            raise
+    _initialized = True
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def global_mesh(
+    axis_names: Sequence[str] = ("session", "batch"),
+) -> jax.sharding.Mesh:
+    """All devices across all hosts as a 2-D (hosts, chips-per-host)
+    mesh: independent sessions shard over the outer axis (traffic rides
+    DCN only at result gather), proof rows over the inner axis (ICI).
+    Rows are host-aligned: devices group by process index, so the inner
+    axis never crosses DCN. Single-host, this degenerates to
+    (1, local chips)."""
+    devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    hosts = jax.process_count()
+    per_host, rem = divmod(len(devices), hosts)
+    if rem:
+        raise ValueError(
+            f"uneven device count: {len(devices)} devices across {hosts} hosts"
+        )
+    return jax.sharding.Mesh(
+        np.array(devices).reshape(hosts, per_host), tuple(axis_names)
+    )

@@ -171,3 +171,15 @@ def test_graft_entry_dryrun():
     import __graft_entry__
 
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_multihost_single_host_degenerates():
+    """multihost: initialize() is a no-op without a coordinator; the
+    global mesh degenerates to (1, local devices)."""
+    from fsdkr_tpu.parallel import multihost
+
+    multihost.initialize()
+    assert not multihost.is_multihost()
+    mesh = multihost.global_mesh()
+    assert mesh.devices.shape == (1, 8)
+    assert mesh.axis_names == ("session", "batch")
