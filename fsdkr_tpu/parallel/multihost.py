@@ -74,6 +74,13 @@ def initialize(
     except RuntimeError as e:
         if "already" not in str(e):  # initialized elsewhere == success
             raise
+    except ValueError:
+        # a cluster marker was present but auto-detection could not
+        # resolve the layout (e.g. this box's TPU tunnel sets
+        # TPU_WORKER_HOSTNAMES for a single worker): explicit arguments
+        # must fail loudly, detection-based calls degrade to single host
+        if explicit:
+            raise
     _initialized = True
 
 
