@@ -2,7 +2,8 @@
 # On-chip measurement battery: run as soon as the TPU tunnel is up.
 # Produces /tmp/m_*.json + logs; each step tolerates failure.
 cd /root/repo
-R=/tmp
+R=/root/repo/bench_results
+mkdir -p "$R"
 run() {  # name, timeout, [VAR=V ...] cmd args...   (no '--': env treats
   # everything up to the first non-assignment word as the command)
   name=$1; to=$2; shift 2
