@@ -80,6 +80,16 @@ def encrypt_with_randomness(ek: EncryptionKey, m: int, r: int) -> int:
     return (gm * intops.mod_pow(r, ek.n, ek.nn)) % ek.nn
 
 
+def combine_with_rn(ms, rn, nv, nnv) -> list:
+    """Assemble ciphertexts from a precomputed r^n column:
+    c = (1 + (m mod n)*n) * r^n mod n^2. The one place the encryption
+    formula lives — callers that batch the modexp column themselves
+    (distribute's fused prover launch) come through here too."""
+    return [
+        (1 + (m % n) * n) * x % nn for m, x, n, nn in zip(ms, rn, nv, nnv)
+    ]
+
+
 def encrypt_with_randomness_batch(eks, ms, rs, powm=None) -> list:
     """Batched chosen-randomness encryption: one modexp column r^n mod n^2
     (the per-receiver encryption fan-out of distribute,
@@ -97,9 +107,9 @@ def encrypt_with_randomness_batch(eks, ms, rs, powm=None) -> list:
         if r <= 0 or math.gcd(r, ek.n) != 1:
             raise ValueError("Paillier randomness must be a unit of Z_n")
     rn = powm(rs, [ek.n for ek in eks], [ek.nn for ek in eks])
-    return [
-        (1 + (m % ek.n) * ek.n) * x % ek.nn for ek, m, x in zip(eks, ms, rn)
-    ]
+    return combine_with_rn(
+        ms, rn, [ek.n for ek in eks], [ek.nn for ek in eks]
+    )
 
 
 def encrypt(ek: EncryptionKey, m: int) -> int:

@@ -65,6 +65,15 @@ def initialize(
     if not explicit and not any(os.environ.get(m) for m in _CLUSTER_MARKERS):
         _initialized = True  # single host: nothing to bring up
         return
+    if not explicit:
+        # distributed init is illegal once a backend is up; a
+        # detection-based call that arrives late degrades to single host
+        # rather than crashing (explicit calls below still fail loudly)
+        from jax._src import xla_bridge
+
+        if getattr(xla_bridge, "_backends", None):
+            _initialized = True
+            return
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

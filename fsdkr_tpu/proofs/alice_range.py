@@ -68,6 +68,71 @@ class AliceProof:
     ) -> "AliceProof":
         return AliceProof.generate_batch([(a, cipher, alice_ek, dlog_statement, r)], q)[0]
 
+    # Two-phase batched prover (same protocol as PDLwSlackProof's: stage1
+    # emits columns, stage2 the response column) so distribute_batch can
+    # fuse both families' same-width columns into shared launches.
+
+    @staticmethod
+    def generate_stage1(avals, rvals, h1v, h2v, ntv, nv, nnv, q: int = CURVE_ORDER):
+        if q.bit_length() > 256:
+            raise ValueError(
+                "SHA-256 transcripts support group orders up to 256 bits"
+            )
+        q3 = q**3
+        alpha = [secrets.randbelow(q3) for _ in ntv]
+        beta = [intops.sample_unit(n) for n in nv]
+        gamma = [secrets.randbelow(q3 * nt) for nt in ntv]
+        rho = [secrets.randbelow(q * nt) for nt in ntv]
+        state = dict(
+            avals=avals, rvals=rvals, alpha=alpha, beta=beta, gamma=gamma,
+            rho=rho, ntv=ntv, nv=nv, nnv=nnv,
+        )
+        cols = [
+            (h1v, avals, ntv),
+            (h2v, rho, ntv),
+            (h1v, alpha, ntv),
+            (h2v, gamma, ntv),
+            (beta, nv, nnv),
+        ]
+        return state, cols
+
+    @staticmethod
+    def generate_stage2(state, results, ciphers):
+        c1, c2, c3, c4, bn = results
+        ntv, nv, nnv = state["ntv"], state["nv"], state["nnv"]
+        alpha = state["alpha"]
+        z = [a * b % nt for a, b, nt in zip(c1, c2, ntv)]
+        w = [a * b % nt for a, b, nt in zip(c3, c4, ntv)]
+        u = [(1 + al * n) * x % nn for al, n, nn, x in zip(alpha, nv, nnv, bn)]
+        e = [
+            _challenge(n, cipher, zi, ui, wi)
+            for cipher, n, zi, ui, wi in zip(ciphers, nv, z, u, w)
+        ]
+        state.update(z=z, e=e)
+        return state, [(state["rvals"], e, nv)]
+
+    @staticmethod
+    def generate_finish(state, results):
+        (re_,) = results
+        alpha, beta, rho, gamma = (
+            state["alpha"], state["beta"], state["rho"], state["gamma"],
+        )
+        proofs = [
+            AliceProof(
+                z=zi,
+                e=ei,
+                s=x * b % n,
+                s1=ei * a + al,
+                s2=ei * ro + ga,
+            )
+            for a, n, zi, ei, x, b, al, ro, ga in zip(
+                state["avals"], state["nv"], state["z"], state["e"], re_,
+                beta, alpha, rho, gamma,
+            )
+        ]
+        intops.zeroize_ints(alpha, beta, rho, gamma)
+        return proofs
+
     @staticmethod
     def generate_batch(items, q: int = CURVE_ORDER, powm=None) -> list["AliceProof"]:
         """Batched prover over items = [(a, cipher, ek, dlog_statement, r)].
@@ -79,47 +144,22 @@ class AliceProof:
         """
         if powm is None:
             from ..backend.powm import host_powm as powm
-        if q.bit_length() > 256:
-            raise ValueError("SHA-256 transcripts support group orders up to 256 bits")
-        q3 = q**3
-        h1v = [d.g for _, _, _, d, _ in items]
-        h2v = [d.ni for _, _, _, d, _ in items]
-        ntv = [d.N for _, _, _, d, _ in items]
-        nv = [ek.n for _, _, ek, _, _ in items]
-        nnv = [ek.nn for _, _, ek, _, _ in items]
+        from ..backend.powm import powm_columns
 
-        alpha = [secrets.randbelow(q3) for _ in items]
-        beta = [intops.sample_unit(n) for n in nv]
-        gamma = [secrets.randbelow(q3 * nt) for nt in ntv]
-        rho = [secrets.randbelow(q * nt) for nt in ntv]
-
-        from .pdl_slack import batched_commitment_pairs
-
-        z, w = batched_commitment_pairs(
-            h1v, h2v, ntv, [a for a, *_ in items], rho, alpha, gamma, powm
+        state, cols = AliceProof.generate_stage1(
+            [a for a, *_ in items],
+            [r for *_, r in items],
+            [d.g for _, _, _, d, _ in items],
+            [d.ni for _, _, _, d, _ in items],
+            [d.N for _, _, _, d, _ in items],
+            [ek.n for _, _, ek, _, _ in items],
+            [ek.nn for _, _, ek, _, _ in items],
+            q,
         )
-        bn = powm(beta, nv, nnv)
-        u = [(1 + al * n) * x % nn for al, n, nn, x in zip(alpha, nv, nnv, bn)]
-
-        e = [
-            _challenge(n, cipher, zi, ui, wi)
-            for (a, cipher, ek, d, r), n, zi, ui, wi in zip(items, nv, z, u, w)
-        ]
-        re_ = powm([r for *_, r in items], e, nv)
-        proofs = [
-            AliceProof(
-                z=zi,
-                e=ei,
-                s=x * b % n,
-                s1=ei * a + al,
-                s2=ei * ro + ga,
-            )
-            for (a, _, _, _, _), n, zi, ei, x, b, al, ro, ga in zip(
-                items, nv, z, e, re_, beta, alpha, rho, gamma
-            )
-        ]
-        intops.zeroize_ints(alpha, beta, rho, gamma)
-        return proofs
+        state, cols2 = AliceProof.generate_stage2(
+            state, powm_columns(powm, *cols), [c for _, c, _, _, _ in items]
+        )
+        return AliceProof.generate_finish(state, powm_columns(powm, *cols2))
 
     def verify(
         self,

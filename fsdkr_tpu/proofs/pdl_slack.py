@@ -47,21 +47,6 @@ def commitment_unknown_order(h1: int, h2: int, modulus: int, x: int, r: int) -> 
     )
 
 
-def batched_commitment_pairs(h1v, h2v, ntv, xs1, rs1, xs2, rs2, powm):
-    """Two batched unknown-order commitments per row — (h1^xs1 * h2^rs1,
-    h1^xs2 * h2^rs2) mod N-tilde — with all four exponent columns fused
-    into ONE modexp launch. Shared by the PDL and Alice-range batched
-    provers (their round-1 commitments have this exact shape)."""
-    from ..backend.powm import powm_columns
-
-    c1, c2, c3, c4 = powm_columns(
-        powm, (h1v, xs1, ntv), (h2v, rs1, ntv), (h1v, xs2, ntv), (h2v, rs2, ntv)
-    )
-    first = [a * b % nt for a, b, nt in zip(c1, c2, ntv)]
-    second = [a * b % nt for a, b, nt in zip(c3, c4, ntv)]
-    return first, second
-
-
 @dataclass(frozen=True)
 class PDLwSlackStatement:
     # field set mirrors /root/reference/src/zk_pdl_with_slack.rs:24-32
@@ -109,6 +94,89 @@ class PDLwSlackProof:
     def prove(witness: PDLwSlackWitness, st: PDLwSlackStatement) -> "PDLwSlackProof":
         return PDLwSlackProof.prove_batch([witness], [st])[0]
 
+    # Two-phase batched prover: stage1 emits the modexp columns of the
+    # round-1 commitments, stage2 (after the fused launch) emits the
+    # response column. distribute_batch drives the PDL and Alice-range
+    # provers (and the encryption column) in lockstep so same-width
+    # columns of BOTH families share one launch — sequential modexp
+    # depth, not row count, prices a launch (backend.powm.powm_columns).
+
+    @staticmethod
+    def prove_stage1(witnesses, h1v, h2v, ntv, nv, nnv):
+        """Sample nonces, return (state, columns): 4 commitment columns
+        mod N~ plus the beta^n column mod n^2."""
+        q = CURVE_ORDER
+        q3 = q**3
+        alpha = [secrets.randbelow(q3) for _ in ntv]
+        beta = [1 + secrets.randbelow(n - 1) for n in nv]
+        rho = [secrets.randbelow(q * nt) for nt in ntv]
+        gamma = [secrets.randbelow(q3 * nt) for nt in ntv]
+        state = dict(
+            witnesses=witnesses, alpha=alpha, beta=beta, rho=rho, gamma=gamma,
+            ntv=ntv, nv=nv, nnv=nnv,
+        )
+        cols = [
+            (h1v, [w.x.to_int() for w in witnesses], ntv),
+            (h2v, rho, ntv),
+            (h1v, alpha, ntv),
+            (h2v, gamma, ntv),
+            (beta, nv, nnv),
+        ]
+        return state, cols
+
+    @staticmethod
+    def prove_stage2(state, results, statements, device_ec: bool = False):
+        """Combine stage-1 results, recompute challenges, return
+        (state, columns): the r^e response column."""
+        c1, c2, c3, c4, bn = results
+        ntv, nv, nnv = state["ntv"], state["nv"], state["nnv"]
+        alpha = state["alpha"]
+        z = [a * b % nt for a, b, nt in zip(c1, c2, ntv)]
+        u3 = [a * b % nt for a, b, nt in zip(c3, c4, ntv)]
+        u2 = [
+            (1 + (al % n) * n) * x % nn
+            for al, n, nn, x in zip(alpha, nv, nnv, bn)
+        ]
+        from ..core.secp256k1 import GENERATOR
+
+        if device_ec and all(st.G == GENERATOR for st in statements):
+            from ..ops.ec_batch import batch_generator_mul
+
+            u1 = batch_generator_mul(alpha)
+        else:
+            u1 = [st.G * Scalar.from_int(al) for st, al in zip(statements, alpha)]
+        e = [
+            PDLwSlackProof._challenge(st, zi, u1i, u2i, u3i)
+            for st, zi, u1i, u2i, u3i in zip(statements, z, u1, u2, u3)
+        ]
+        state.update(z=z, u1=u1, u2=u2, u3=u3, e=e)
+        return state, [([w.r for w in state["witnesses"]], e, nv)]
+
+    @staticmethod
+    def prove_finish(state, results):
+        (re_,) = results
+        alpha, beta, rho, gamma = (
+            state["alpha"], state["beta"], state["rho"], state["gamma"],
+        )
+        proofs = [
+            PDLwSlackProof(
+                z=zi,
+                u1=u1i,
+                u2=u2i,
+                u3=u3i,
+                s1=ei * w.x.to_int() + al,
+                s2=x * b % n,
+                s3=ei * ro + ga,
+            )
+            for w, n, zi, u1i, u2i, u3i, ei, x, b, al, ro, ga in zip(
+                state["witnesses"], state["nv"], state["z"], state["u1"],
+                state["u2"], state["u3"], state["e"], re_, beta, alpha, rho,
+                gamma,
+            )
+        ]
+        intops.zeroize_ints(alpha, beta, rho, gamma)
+        return proofs
+
     @staticmethod
     def prove_batch(
         witnesses: list[PDLwSlackWitness],
@@ -130,55 +198,20 @@ class PDLwSlackProof:
                 f"batch length mismatch: {len(witnesses)} witnesses, "
                 f"{len(statements)} statements"
             )
-        q = CURVE_ORDER
-        q3 = q**3
-        ntv = [st.N_tilde for st in statements]
-        nv = [st.ek.n for st in statements]
-        nnv = [st.ek.nn for st in statements]
+        from ..backend.powm import powm_columns
 
-        alpha = [secrets.randbelow(q3) for _ in statements]
-        beta = [1 + secrets.randbelow(n - 1) for n in nv]
-        rho = [secrets.randbelow(q * nt) for nt in ntv]
-        gamma = [secrets.randbelow(q3 * nt) for nt in ntv]
-
-        h1v = [st.h1 for st in statements]
-        h2v = [st.h2 for st in statements]
-        z, u3 = batched_commitment_pairs(
-            h1v, h2v, ntv,
-            [w.x.to_int() for w in witnesses], rho, alpha, gamma, powm,
+        state, cols = PDLwSlackProof.prove_stage1(
+            witnesses,
+            [st.h1 for st in statements],
+            [st.h2 for st in statements],
+            [st.N_tilde for st in statements],
+            [st.ek.n for st in statements],
+            [st.ek.nn for st in statements],
         )
-        from ..core.secp256k1 import GENERATOR
-
-        if device_ec and all(st.G == GENERATOR for st in statements):
-            from ..ops.ec_batch import batch_generator_mul
-
-            u1 = batch_generator_mul(alpha)
-        else:
-            u1 = [st.G * Scalar.from_int(al) for st, al in zip(statements, alpha)]
-        bn = powm(beta, nv, nnv)
-        u2 = [(1 + (al % n) * n) * x % nn for al, n, nn, x in zip(alpha, nv, nnv, bn)]
-
-        e = [
-            PDLwSlackProof._challenge(st, zi, u1i, u2i, u3i)
-            for st, zi, u1i, u2i, u3i in zip(statements, z, u1, u2, u3)
-        ]
-        re_ = powm([w.r for w in witnesses], e, nv)
-        proofs = [
-            PDLwSlackProof(
-                z=zi,
-                u1=u1i,
-                u2=u2i,
-                u3=u3i,
-                s1=ei * w.x.to_int() + al,
-                s2=x * b % n,
-                s3=ei * ro + ga,
-            )
-            for w, n, zi, u1i, u2i, u3i, ei, x, b, al, ro, ga in zip(
-                witnesses, nv, z, u1, u2, u3, e, re_, beta, alpha, rho, gamma
-            )
-        ]
-        intops.zeroize_ints(alpha, beta, rho, gamma)
-        return proofs
+        state, cols2 = PDLwSlackProof.prove_stage2(
+            state, powm_columns(powm, *cols), statements, device_ec
+        )
+        return PDLwSlackProof.prove_finish(state, powm_columns(powm, *cols2))
 
     def verify(self, st: PDLwSlackStatement) -> None:
         """Raises PDLwSlackProofError with per-equation booleans on failure

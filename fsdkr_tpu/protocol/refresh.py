@@ -185,19 +185,55 @@ class RefreshMessage:
                 for p in per:
                     p["points"] = [GENERATOR * s for s in p["shares"]]
 
-        # ---- fused encryption column over all (sender, receiver) pairs
-        with phase("distribute.encrypt", items=len(per) * new_n):
-            flat_enc = paillier.encrypt_with_randomness_batch(
-                [ek for p in per for ek in p["eks"]],
-                flat_share_ints,
-                [r for p in per for r in p["rand"]],
-                powm,
+        # ---- fully fused prover columns over all (sender, receiver)
+        # pairs: the encryption column and BOTH proof families' stage-1
+        # commitment columns share launches by exponent width (the
+        # encryption r^n and the two beta^n columns are one 2048-bit
+        # launch; x/a, rho, alpha, gamma columns pair up likewise), then
+        # both families' r^e response columns share the stage-2 launch.
+        # A launch is priced by its sequential modexp depth, so halving
+        # the launch count at fixed width ~halves prover latency when
+        # batches underfeed the chip.
+        from ..backend.powm import powm_columns
+
+        flat_rand = [r for p in per for r in p["rand"]]
+        flat_nv = [ek.n for p in per for ek in p["eks"]]
+        flat_nnv = [ek.nn for p in per for ek in p["eks"]]
+        flat_h1 = [p["key"].h1_h2_n_tilde_vec[i].g for p in per for i in range(new_n)]
+        flat_h2 = [p["key"].h1_h2_n_tilde_vec[i].ni for p in per for i in range(new_n)]
+        flat_nt = [p["key"].h1_h2_n_tilde_vec[i].N for p in per for i in range(new_n)]
+        flat_witnesses = [
+            PDLwSlackWitness(x=s, r=r)
+            for p in per
+            for s, r in zip(p["shares"], p["rand"])
+        ]
+
+        with phase("distribute.prove_stage1", items=len(flat_rand)):
+            pdl_state, pdl_cols = PDLwSlackProof.prove_stage1(
+                flat_witnesses, flat_h1, flat_h2, flat_nt, flat_nv, flat_nnv
             )
-        del flat_share_ints  # share ints live on only inside per[..]["shares"]
+            alice_state, alice_cols = AliceProof.generate_stage1(
+                flat_share_ints, flat_rand, flat_h1, flat_h2, flat_nt,
+                flat_nv, flat_nnv,
+            )
+            enc_col = (flat_rand, flat_nv, flat_nnv)  # r^n mod n^2
+            res1 = powm_columns(powm, enc_col, *pdl_cols, *alice_cols)
+            n_pdl = len(pdl_cols)
+            pdl_res1 = res1[1 : 1 + n_pdl]
+            alice_res1 = res1[1 + n_pdl : 1 + n_pdl + len(alice_cols)]
+
+        # ciphertexts from the fused encryption column (randomness is
+        # unit-sampled above, the guarantee encrypt_with_randomness_batch
+        # enforces)
+        flat_enc = paillier.combine_with_rn(
+            flat_share_ints, res1[0], flat_nv, flat_nnv
+        )
+        # (the share ints also live on as alice_state["avals"] until the
+        # proofs are assembled — same round-state lifetime as the nonces)
+        del flat_share_ints
         for k, p in enumerate(per):
             p["enc"] = flat_enc[k * new_n : (k + 1) * new_n]
 
-        # ---- fused PDL + range prover columns
         flat_statements = [
             PDLwSlackStatement(
                 ciphertext=p["enc"][i],
@@ -211,33 +247,21 @@ class RefreshMessage:
             for p in per
             for i in range(new_n)
         ]
-        flat_witnesses = [
-            PDLwSlackWitness(x=s, r=r)
-            for p in per
-            for s, r in zip(p["shares"], p["rand"])
-        ]
-        with phase("distribute.pdl_prove", items=len(flat_witnesses)):
-            flat_pdl = PDLwSlackProof.prove_batch(
-                flat_witnesses,
-                flat_statements,
-                powm,
+
+        with phase("distribute.prove_stage2", items=len(flat_rand)):
+            pdl_state, pdl_cols2 = PDLwSlackProof.prove_stage2(
+                pdl_state, pdl_res1, flat_statements,
                 device_ec=config.device_ec,
             )
-
-        with phase("distribute.range_prove", items=len(per) * new_n):
-            flat_range = AliceProof.generate_batch(
-                [
-                    (
-                        p["shares"][i].to_int(),
-                        p["enc"][i],
-                        p["eks"][i],
-                        p["key"].h1_h2_n_tilde_vec[i],
-                        p["rand"][i],
-                    )
-                    for p in per
-                    for i in range(new_n)
-                ],
-                powm=powm,
+            alice_state, alice_cols2 = AliceProof.generate_stage2(
+                alice_state, alice_res1, flat_enc
+            )
+            res2 = powm_columns(powm, *pdl_cols2, *alice_cols2)
+            flat_pdl = PDLwSlackProof.prove_finish(
+                pdl_state, res2[: len(pdl_cols2)]
+            )
+            flat_range = AliceProof.generate_finish(
+                alice_state, res2[len(pdl_cols2) :]
             )
 
         # ---- per-sender keygens (host-serial, native Miller-Rabin) and

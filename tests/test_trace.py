@@ -51,8 +51,8 @@ class TestProtocolIntegration:
             tracer.disable()
         stats = tracer.stats()
         for expected in (
-            "distribute.encrypt",
-            "distribute.pdl_prove",
+            "distribute.prove_stage1",
+            "distribute.prove_stage2",
             "collect.verify_pdl",
             "collect.verify_ring_pedersen",
             "collect.validate_feldman",
