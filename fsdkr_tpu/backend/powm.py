@@ -297,8 +297,32 @@ def powm_columns(powm: BatchPowm, *columns):
     """
     from ..ops.limbs import bucket_exp_bits
 
+    # Identical columns share one computation: the PDL and Alice range
+    # provers both commit h1^x mod N~ over the same share column, so
+    # distribute_batch submits that column twice. Full-content comparison
+    # (big-int lists) happens only on a prefix collision, so always-
+    # distinct columns (the verifier paths) pay a 4-tuple hash, not a
+    # whole-column hash.
+    by_prefix: dict = {}  # cheap prefix -> [column indices]
+    alias: dict = {}  # later column index -> first column index
     flat: dict = {}  # width class -> (bases, exps, moduli, [(col, lo, hi)])
     for col, (bases, exps, moduli) in enumerate(columns):
+        prefix = (
+            len(bases),
+            bases[0] if bases else 0,
+            exps[0] if exps else 0,
+            moduli[0] if moduli else 0,
+        )
+        dup = None
+        for prev in by_prefix.get(prefix, ()):
+            pb, pe, pm = columns[prev]
+            if list(pb) == list(bases) and list(pe) == list(exps) and list(pm) == list(moduli):
+                dup = prev
+                break
+        if dup is not None:
+            alias[col] = dup
+            continue
+        by_prefix.setdefault(prefix, []).append(col)
         w = bucket_exp_bits(exps)
         b, e, m, spans = flat.setdefault(w, ([], [], [], []))
         spans.append((col, len(b), len(b) + len(bases)))
@@ -311,4 +335,6 @@ def powm_columns(powm: BatchPowm, *columns):
         res = powm(b, e, m)
         for col, lo, hi in spans:
             out[col] = res[lo:hi]
+    for col, dup in alias.items():
+        out[col] = list(out[dup])  # fresh list: no aliasing across columns
     return out

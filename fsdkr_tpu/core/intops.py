@@ -20,6 +20,7 @@ __all__ = [
     "mod_pow_signed",
     "mod_inv",
     "mod_mul",
+    "mod_mul_col",
     "sample_below",
     "sample_range",
     "sample_bits",
@@ -89,6 +90,12 @@ def mod_inv(x: int, modulus: int):
 
 def mod_mul(a: int, b: int, modulus: int) -> int:
     return (a * b) % modulus
+
+
+def mod_mul_col(a, b, moduli) -> list:
+    """Row-wise a[i]*b[i] mod moduli[i] — the commitment pair-combine of
+    the staged provers (z = c1*c2, u3/w = c3*c4 over unknown-order Z_N~)."""
+    return [x * y % m for x, y, m in zip(a, b, moduli)]
 
 
 def sample_below(bound: int) -> int:

@@ -68,10 +68,20 @@ def initialize(
     if not explicit:
         # distributed init is illegal once a backend is up; a
         # detection-based call that arrives late degrades to single host
-        # rather than crashing (explicit calls below still fail loudly)
-        from jax._src import xla_bridge
+        # rather than crashing (explicit calls below still fail loudly).
+        # The degradation is warned, not silent: on a real cluster it
+        # means every host runs its own single-host protocol.
+        if _backend_already_up():
+            import warnings
 
-        if getattr(xla_bridge, "_backends", None):
+            warnings.warn(
+                "fsdkr_tpu.multihost.initialize() called after the JAX "
+                "backend initialized; degrading to single-host. Call "
+                "initialize() before any jax.devices()/computation, or "
+                "pass explicit coordinator arguments.",
+                RuntimeWarning,
+                stacklevel=2,
+            )
             _initialized = True
             return
     try:
@@ -91,6 +101,18 @@ def initialize(
         if explicit:
             raise
     _initialized = True
+
+
+def _backend_already_up() -> bool:
+    """True if any JAX backend has initialized in this process."""
+    try:
+        from jax._src import xla_bridge
+
+        if hasattr(xla_bridge, "backends_are_initialized"):
+            return xla_bridge.backends_are_initialized()
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        return False  # unknown internals: let jax.distributed decide
 
 
 def is_multihost() -> bool:

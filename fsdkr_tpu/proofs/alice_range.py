@@ -101,9 +101,11 @@ class AliceProof:
         c1, c2, c3, c4, bn = results
         ntv, nv, nnv = state["ntv"], state["nv"], state["nnv"]
         alpha = state["alpha"]
-        z = [a * b % nt for a, b, nt in zip(c1, c2, ntv)]
-        w = [a * b % nt for a, b, nt in zip(c3, c4, ntv)]
-        u = [(1 + al * n) * x % nn for al, n, nn, x in zip(alpha, nv, nnv, bn)]
+        from ..core import paillier
+
+        z = intops.mod_mul_col(c1, c2, ntv)
+        w = intops.mod_mul_col(c3, c4, ntv)
+        u = paillier.combine_with_rn(alpha, bn, nv, nnv)  # Enc(alpha; beta)
         e = [
             _challenge(n, cipher, zi, ui, wi)
             for cipher, n, zi, ui, wi in zip(ciphers, nv, z, u, w)

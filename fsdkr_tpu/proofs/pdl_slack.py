@@ -131,12 +131,11 @@ class PDLwSlackProof:
         c1, c2, c3, c4, bn = results
         ntv, nv, nnv = state["ntv"], state["nv"], state["nnv"]
         alpha = state["alpha"]
-        z = [a * b % nt for a, b, nt in zip(c1, c2, ntv)]
-        u3 = [a * b % nt for a, b, nt in zip(c3, c4, ntv)]
-        u2 = [
-            (1 + (al % n) * n) * x % nn
-            for al, n, nn, x in zip(alpha, nv, nnv, bn)
-        ]
+        from ..core import paillier
+
+        z = intops.mod_mul_col(c1, c2, ntv)
+        u3 = intops.mod_mul_col(c3, c4, ntv)
+        u2 = paillier.combine_with_rn(alpha, bn, nv, nnv)  # Enc(alpha; beta)
         from ..core.secp256k1 import GENERATOR
 
         if device_ec and all(st.G == GENERATOR for st in statements):

@@ -224,10 +224,11 @@ class RefreshMessage:
 
         # ciphertexts from the fused encryption column (randomness is
         # unit-sampled above, the guarantee encrypt_with_randomness_batch
-        # enforces)
-        flat_enc = paillier.combine_with_rn(
-            flat_share_ints, res1[0], flat_nv, flat_nnv
-        )
+        # enforces); own phase: ~n^2 host bigint multiplies at scale
+        with phase("distribute.encrypt", items=len(flat_share_ints)):
+            flat_enc = paillier.combine_with_rn(
+                flat_share_ints, res1[0], flat_nv, flat_nnv
+            )
         # (the share ints also live on as alice_state["avals"] until the
         # proofs are assembled — same round-state lifetime as the nonces)
         del flat_share_ints

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Start the measurement battery once the single core is free of test
+# runs. Tunnel health is handled inside measure_all.sh (it probes before
+# every step and waits out tunnel outages), so the watchdog only guards
+# against CPU contention and the manual pause switch.
+cd /root/repo
+R=/root/repo/bench_results
+mkdir -p "$R"
+log() { echo "[$(date +%H:%M:%S)] $*" >> "$R/watchdog.log"; }
+log "watchdog start"
+while [ -f /tmp/fsdkr_no_bench ] || pgrep -f pytest > /dev/null; do
+  sleep 60
+done
+log "starting battery"
+bash scripts/measure_all.sh >> "$R/battery_run.log" 2>&1
+log "battery finished rc=$?"

@@ -175,10 +175,16 @@ def test_graft_entry_dryrun():
 
 def test_multihost_single_host_degenerates():
     """multihost: initialize() is a no-op without a coordinator; the
-    global mesh degenerates to (1, local devices)."""
+    global mesh degenerates to (1, local devices). On this box the TPU
+    tunnel exports TPU_WORKER_HOSTNAMES, so a late detection-based call
+    warns as it degrades — that warning is the documented behavior."""
+    import warnings
+
     from fsdkr_tpu.parallel import multihost
 
-    multihost.initialize()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        multihost.initialize()
     assert not multihost.is_multihost()
     mesh = multihost.global_mesh()
     assert mesh.devices.shape == (1, 8)
