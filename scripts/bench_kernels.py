@@ -24,6 +24,59 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+class PointTimeout(Exception):
+    pass
+
+
+class point_deadline:
+    """Deadline around one measurement point. Two layers:
+
+    - SIGALRM at T seconds: raises PointTimeout if the interpreter is
+      running Python bytecode (slow but live point -> skip gracefully);
+    - a monitor thread at 1.5*T: os._exit(75) for hangs stuck inside a
+      C-level device call (a dead TPU tunnel never returns, and Python
+      signals cannot interrupt it). Already-printed JSON lines are
+      flushed, so completed points survive the exit.
+
+    T via FSDKR_POINT_TIMEOUT, default 600.
+    """
+
+    def __init__(self):
+        self.seconds = int(os.environ.get("FSDKR_POINT_TIMEOUT", "600"))
+
+    def __enter__(self):
+        if self.seconds <= 0:  # 0 disables the deadline entirely
+            self._done = None
+            return
+        import signal
+        import threading
+
+        def _raise(signum, frame):
+            raise PointTimeout(f"point exceeded {self.seconds}s")
+
+        self._old = signal.signal(signal.SIGALRM, _raise)
+        signal.alarm(self.seconds)
+        self._done = threading.Event()
+
+        def _hard_exit():
+            if not self._done.wait(self.seconds * 1.5):
+                log(f"point hung past {self.seconds * 1.5:.0f}s; exiting 75")
+                os._exit(75)
+
+        self._mon = threading.Thread(target=_hard_exit, daemon=True)
+        self._mon.start()
+
+    def __exit__(self, *exc):
+        if self._done is None:
+            return False
+        import signal
+
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, self._old)
+        self._done.set()
+        return False
+
+
 def _workload(bits, exp_bits, rows, seed=0):
     import random
 
@@ -181,7 +234,8 @@ def main():
     for bits, e, rows in generic_points:
         for kind in kinds:
             try:
-                measure_generic(kind, bits, e, rows)
+                with point_deadline():
+                    measure_generic(kind, bits, e, rows)
             except Exception as ex:
                 log(f"  {kind} bits={bits} e={e} rows={rows}: FAILED {ex}")
 
@@ -189,7 +243,8 @@ def main():
     for rows in batch_sweep:
         for kind in kinds:
             try:
-                measure_generic(kind, 2048, 2048, rows)
+                with point_deadline():
+                    measure_generic(kind, 2048, 2048, rows)
             except Exception as ex:
                 log(f"  {kind} rows={rows}: FAILED {ex}")
 
@@ -200,7 +255,8 @@ def main():
     for bits, e, g, m in comb_points:
         for kind in comb_kinds:
             try:
-                measure_comb(kind, bits, e, g, m)
+                with point_deadline():
+                    measure_comb(kind, bits, e, g, m)
             except Exception as ex:
                 log(f"  {kind} bits={bits} e={e} G={g} M={m}: FAILED {ex}")
 

@@ -8,7 +8,11 @@ R=/root/repo/bench_results
 mkdir -p "$R"
 log() { echo "[$(date +%H:%M:%S)] $*" >> "$R/watchdog.log"; }
 log "watchdog start"
-while [ -f /tmp/fsdkr_no_bench ] || pgrep -f pytest > /dev/null; do
+# anchored: match actual pytest processes only — `python -m pytest`,
+# `pytest`, or `python /path/to/pytest` — not other long-running
+# processes on this box that merely mention pytest in their argv
+PYTEST_PAT='^[^ ]*python[0-9.]* (-m )?([^ ]*/)?pytest|^([^ ]*/)?pytest( |$)'
+while [ -f /tmp/fsdkr_no_bench ] || pgrep -f "$PYTEST_PAT" > /dev/null; do
   sleep 60
 done
 log "starting battery"
