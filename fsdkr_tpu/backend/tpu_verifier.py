@@ -83,35 +83,45 @@ class TpuBatchVerifier(BatchVerifier):
             return []
         q3 = CURVE_ORDER**3
 
-        e_vec = [
-            PDLwSlackProof._challenge(st, p.z, p.u1, p.u2, p.u3) for p, st in items
-        ]
+        from ..utils.trace import phase
+
+        # sub-phases split host work (challenge hashing, int<->device
+        # conversion riding inside the launch wrappers) from the EC check,
+        # so on-chip traces show where a verify family's seconds go
+        with phase("pdl.challenge", items=len(items)):
+            e_vec = [
+                PDLwSlackProof._challenge(st, p.z, p.u1, p.u2, p.u3)
+                for p, st in items
+            ]
 
         from .powm import powm_columns
 
         # mod n^2 columns fused into one launch, mod N~ columns into another
-        nn_mod = [st.ek.nn for _, st in items]
-        nt_mod = [st.N_tilde for _, st in items]
-        c_e, s2_n = powm_columns(
-            _modexp,
-            ([st.ciphertext for _, st in items], e_vec, nn_mod),
-            ([p.s2 for p, _ in items], [st.ek.n for _, st in items], nn_mod),
-        )
-        z_e, h1_s1, h2_s3 = powm_columns(
-            _modexp,
-            ([p.z for p, _ in items], e_vec, nt_mod),
-            ([st.h1 for _, st in items], [p.s1 for p, _ in items], nt_mod),
-            ([st.h2 for _, st in items], [p.s3 for p, _ in items], nt_mod),
-        )
-        lhs2 = _modmul([p.u2 for p, _ in items], c_e, nn_mod)
-        gs1 = [
-            (1 + (p.s1 % st.ek.n) * st.ek.n) % st.ek.nn for p, st in items
-        ]
-        rhs2 = _modmul(gs1, s2_n, nn_mod)
-        lhs3 = _modmul([p.u3 for p, _ in items], z_e, nt_mod)
-        rhs3 = _modmul(h1_s1, h2_s3, nt_mod)
+        with phase("pdl.modexp_columns", items=5 * len(items)):
+            nn_mod = [st.ek.nn for _, st in items]
+            nt_mod = [st.N_tilde for _, st in items]
+            c_e, s2_n = powm_columns(
+                _modexp,
+                ([st.ciphertext for _, st in items], e_vec, nn_mod),
+                ([p.s2 for p, _ in items], [st.ek.n for _, st in items], nn_mod),
+            )
+            z_e, h1_s1, h2_s3 = powm_columns(
+                _modexp,
+                ([p.z for p, _ in items], e_vec, nt_mod),
+                ([st.h1 for _, st in items], [p.s1 for p, _ in items], nt_mod),
+                ([st.h2 for _, st in items], [p.s3 for p, _ in items], nt_mod),
+            )
+        with phase("pdl.combine", items=len(items)):
+            lhs2 = _modmul([p.u2 for p, _ in items], c_e, nn_mod)
+            gs1 = [
+                (1 + (p.s1 % st.ek.n) * st.ek.n) % st.ek.nn for p, st in items
+            ]
+            rhs2 = _modmul(gs1, s2_n, nn_mod)
+            lhs3 = _modmul([p.u3 for p, _ in items], z_e, nt_mod)
+            rhs3 = _modmul(h1_s1, h2_s3, nt_mod)
 
-        ok1_vec = self._pdl_u1_batch(items, e_vec)
+        with phase("pdl.ec_u1", items=len(items)):
+            ok1_vec = self._pdl_u1_batch(items, e_vec)
 
         out = []
         for idx, (proof, st) in enumerate(items):
@@ -190,82 +200,93 @@ class TpuBatchVerifier(BatchVerifier):
             return []
         q3 = CURVE_ORDER**3
 
+        from ..utils.trace import phase
+
         nn_mod = [ek.nn for _, _, ek, _ in items]
         nt_mod = [dlog.N for _, _, _, dlog in items]
         e_vec = [p.e for p, _, _, _ in items]
 
         from .powm import powm_columns
 
-        z_e, h1_s1, h2_s2 = powm_columns(
-            _modexp,
-            ([p.z for p, _, _, _ in items], e_vec, nt_mod),
-            (
-                [dlog.g for _, _, _, dlog in items],
-                [p.s1 for p, _, _, _ in items],
-                nt_mod,
-            ),
-            (
-                [dlog.ni for _, _, _, dlog in items],
-                [p.s2 for p, _, _, _ in items],
-                nt_mod,
-            ),
-        )
-        c_e, s_n = powm_columns(
-            _modexp,
-            ([c for _, c, _, _ in items], e_vec, nn_mod),
-            (
-                [p.s for p, _, _, _ in items],
-                [ek.n for _, _, ek, _ in items],
-                nn_mod,
-            ),
-        )
-
-        w_part = _modmul(h1_s1, h2_s2, nt_mod)
-        gs1 = [(1 + p.s1 * ek.n) % ek.nn for p, _, ek, _ in items]
-        u_part = _modmul(gs1, s_n, nn_mod)
-
-        z_e_inv_vec = self._batch_inv(z_e, nt_mod)
-        c_e_inv_vec = self._batch_inv(c_e, nn_mod)
-
-        out = []
-        for idx, (proof, cipher, ek, dlog) in enumerate(items):
-            if proof.s1 > q3 or proof.s1 < 0:
-                out.append(False)
-                continue
-            z_e_inv = z_e_inv_vec[idx]
-            c_e_inv = c_e_inv_vec[idx]
-            if z_e_inv is None or c_e_inv is None:
-                out.append(False)
-                continue
-            w = w_part[idx] * z_e_inv % dlog.N
-            u = u_part[idx] * c_e_inv % ek.nn
-            out.append(
-                alice_range._challenge(ek.n, cipher, proof.z, u, w) == proof.e
+        with phase("range.modexp_columns", items=5 * len(items)):
+            z_e, h1_s1, h2_s2 = powm_columns(
+                _modexp,
+                ([p.z for p, _, _, _ in items], e_vec, nt_mod),
+                (
+                    [dlog.g for _, _, _, dlog in items],
+                    [p.s1 for p, _, _, _ in items],
+                    nt_mod,
+                ),
+                (
+                    [dlog.ni for _, _, _, dlog in items],
+                    [p.s2 for p, _, _, _ in items],
+                    nt_mod,
+                ),
             )
+            c_e, s_n = powm_columns(
+                _modexp,
+                ([c for _, c, _, _ in items], e_vec, nn_mod),
+                (
+                    [p.s for p, _, _, _ in items],
+                    [ek.n for _, _, ek, _ in items],
+                    nn_mod,
+                ),
+            )
+
+        with phase("range.combine", items=len(items)):
+            w_part = _modmul(h1_s1, h2_s2, nt_mod)
+            gs1 = [(1 + p.s1 * ek.n) % ek.nn for p, _, ek, _ in items]
+            u_part = _modmul(gs1, s_n, nn_mod)
+
+        with phase("range.batch_inv", items=2 * len(items)):
+            z_e_inv_vec = self._batch_inv(z_e, nt_mod)
+            c_e_inv_vec = self._batch_inv(c_e, nn_mod)
+
+        with phase("range.challenge", items=len(items)):
+            out = []
+            for idx, (proof, cipher, ek, dlog) in enumerate(items):
+                if proof.s1 > q3 or proof.s1 < 0:
+                    out.append(False)
+                    continue
+                z_e_inv = z_e_inv_vec[idx]
+                c_e_inv = c_e_inv_vec[idx]
+                if z_e_inv is None or c_e_inv is None:
+                    out.append(False)
+                    continue
+                w = w_part[idx] * z_e_inv % dlog.N
+                u = u_part[idx] * c_e_inv % ek.nn
+                out.append(
+                    alice_range._challenge(ek.n, cipher, proof.z, u, w)
+                    == proof.e
+                )
         return out
 
     # ------------------------------------------------------------------
     def verify_ring_pedersen(self, items, m_security):
         if not items:
             return []
+        from ..utils.trace import phase
+
         bases, exps, moduli, rhs_a, rhs_s = [], [], [], [], []
         shapes_ok = []
-        for proof, st in items:
-            ok = len(proof.A) == m_security and len(proof.Z) == m_security
-            shapes_ok.append(ok)
-            if not ok:
-                continue
-            e = RingPedersenProof._challenge(proof.A)
-            bits = challenge_bits(e, m_security)
-            for a_i, z_i, b in zip(proof.A, proof.Z, bits):
-                bases.append(st.T)
-                exps.append(z_i)
-                moduli.append(st.N)
-                rhs_a.append(a_i)
-                rhs_s.append(st.S if b else 1)
+        with phase("ringped.challenge", items=len(items)):
+            for proof, st in items:
+                ok = len(proof.A) == m_security and len(proof.Z) == m_security
+                shapes_ok.append(ok)
+                if not ok:
+                    continue
+                e = RingPedersenProof._challenge(proof.A)
+                bits = challenge_bits(e, m_security)
+                for a_i, z_i, b in zip(proof.A, proof.Z, bits):
+                    bases.append(st.T)
+                    exps.append(z_i)
+                    moduli.append(st.N)
+                    rhs_a.append(a_i)
+                    rhs_s.append(st.S if b else 1)
 
-        lhs = _modexp(bases, exps, moduli)
-        rhs = _modmul(rhs_a, rhs_s, moduli)
+        with phase("ringped.modexp", items=len(bases)):
+            lhs = _modexp(bases, exps, moduli)
+            rhs = _modmul(rhs_a, rhs_s, moduli)
 
         out = []
         row = 0
@@ -286,27 +307,33 @@ class TpuBatchVerifier(BatchVerifier):
             return []
         import math
 
+        from ..utils.trace import phase
+
         bases, exps, moduli, want = [], [], [], []
         gates = []
-        for proof, ek in items:
-            n = ek.n
-            gate = (
-                len(proof.sigma_vec) == rounds
-                and n > 0
-                and n % 2 == 1
-                and math.gcd(n, correct_key._PRIMORIAL) == 1
-                and all(0 < s < n for s in proof.sigma_vec)
-            )
-            gates.append(gate)
-            if not gate:
-                continue
-            for i, sigma in enumerate(proof.sigma_vec):
-                bases.append(sigma)
-                exps.append(n)
-                moduli.append(n)
-                want.append(correct_key._derive_rho(n, correct_key.SALT_STRING, i))
+        with phase("correct_key.rho_derive", items=len(items)):
+            for proof, ek in items:
+                n = ek.n
+                gate = (
+                    len(proof.sigma_vec) == rounds
+                    and n > 0
+                    and n % 2 == 1
+                    and math.gcd(n, correct_key._PRIMORIAL) == 1
+                    and all(0 < s < n for s in proof.sigma_vec)
+                )
+                gates.append(gate)
+                if not gate:
+                    continue
+                for i, sigma in enumerate(proof.sigma_vec):
+                    bases.append(sigma)
+                    exps.append(n)
+                    moduli.append(n)
+                    want.append(
+                        correct_key._derive_rho(n, correct_key.SALT_STRING, i)
+                    )
 
-        got = _modexp(bases, exps, moduli)
+        with phase("correct_key.modexp", items=len(bases)):
+            got = _modexp(bases, exps, moduli)
 
         out = []
         row = 0
@@ -324,12 +351,19 @@ class TpuBatchVerifier(BatchVerifier):
         if not items:
             return []
         from ..proofs.composite_dlog import CompositeDLogProof
+        from ..utils.trace import phase
 
-        e_vec = [CompositeDLogProof._challenge(p.x_commit, st) for p, st in items]
+        with phase("composite_dlog.challenge", items=len(items)):
+            e_vec = [
+                CompositeDLogProof._challenge(p.x_commit, st) for p, st in items
+            ]
         moduli = [st.N for _, st in items]
-        g_y = _modexp([st.g for _, st in items], [p.y for p, _ in items], moduli)
-        ni_e = _modexp([st.ni for _, st in items], e_vec, moduli)
-        lhs = _modmul(g_y, ni_e, moduli)
+        with phase("composite_dlog.modexp", items=2 * len(items)):
+            g_y = _modexp(
+                [st.g for _, st in items], [p.y for p, _ in items], moduli
+            )
+            ni_e = _modexp([st.ni for _, st in items], e_vec, moduli)
+            lhs = _modmul(g_y, ni_e, moduli)
         return [
             0 < p.x_commit < st.N and p.y >= 0 and lhs[idx] == p.x_commit
             for idx, (p, st) in enumerate(items)

@@ -7,6 +7,7 @@
 cd /root/repo
 R=/root/repo/bench_results
 mkdir -p "$R"
+echo $$ > "$R/.battery.pid"
 
 probe() {  # 0 = healthy
   timeout 120 python - <<'EOF' > /dev/null 2>&1

@@ -6,6 +6,7 @@
 cd /root/repo
 R=/root/repo/bench_results
 mkdir -p "$R"
+echo $$ > "$R/.watchdog.pid"
 log() { echo "[$(date +%H:%M:%S)] $*" >> "$R/watchdog.log"; }
 log "watchdog start"
 # anchored: match actual pytest processes only — `python -m pytest`,
