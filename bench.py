@@ -249,6 +249,15 @@ def main():
     t_distribute = time.time() - t0
     log(f"setup done: keygen {t_keygen:.1f}s, distribute {t_distribute:.1f}s")
 
+    from fsdkr_tpu.utils.trace import get_tracer
+
+    # prover-side phase split (includes first-launch compiles)
+    trace_distribute = {
+        name: round(st.seconds, 3)
+        for name, st in get_tracer().stats().items()
+        if name.startswith("distribute.")
+    } or None
+
     # proof instances verified by one collect (excluding n^2 Feldman EC
     # checks and 2 joins' dlog proofs, which are zero here)
     proofs = 2 * n * n + 2 * n
@@ -260,15 +269,18 @@ def main():
     t_tpu_cold = time.time() - t0
     log(f"tpu collect cold: {t_tpu_cold:.2f}s")
 
-    from fsdkr_tpu.utils.trace import get_tracer
-
     get_tracer().reset()
     t0 = time.time()
     RefreshMessage.collect(msgs, keys[1].clone(), dks[1], (), tpu_cfg)
     t_tpu = time.time() - t0
     log(f"tpu collect warm: {t_tpu:.2f}s -> {proofs / t_tpu:.1f} proofs/s")
+    trace_out = None
     if get_tracer().enabled:  # FSDKR_TRACE=1: per-family breakdown
         log(get_tracer().report())
+        trace_out = {
+            name: round(st.seconds, 3)
+            for name, st in get_tracer().stats().items()
+        }
 
     # --- host baseline on a subsample (serial loop; linear extrapolation)
     # Two baselines: the native C++ Montgomery path (intops.mod_pow routes
@@ -362,6 +374,10 @@ def main():
         "collect_cold_s": round(t_tpu_cold, 2),
         "distribute_batch_s": round(t_distribute, 2),
     }
+    if trace_out:
+        result["trace"] = trace_out  # warm-collect per-phase seconds
+    if trace_distribute:
+        result["trace_distribute"] = trace_distribute
     emit(result)
 
 

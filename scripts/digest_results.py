@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Digest bench_results/ into markdown tables for BASELINE.md.
+
+Reads every m_*.json the battery produced (collect configs: one JSON
+object; kernel sweep: JSON lines) and prints two markdown tables to
+stdout: the collect()/config table and the kernel sweep table, plus a
+per-phase breakdown for each traced config. Purely offline — safe to run
+any time.
+
+Usage: python scripts/digest_results.py [bench_results_dir]
+"""
+
+import json
+import pathlib
+import sys
+
+
+def load(path):
+    recs = []
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            recs.append(json.loads(line))
+        except json.JSONDecodeError:
+            pass
+    return recs
+
+
+def main():
+    root = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else "bench_results")
+    configs, kernels, traces = [], [], {}
+    for path in sorted(root.glob("m_*.json")):
+        name = path.stem[2:]
+        for rec in load(path):
+            if "kernel" in rec:
+                kernels.append(rec)
+            elif "metric" in rec:
+                configs.append((name, rec))
+                if rec.get("trace"):
+                    traces[f"{name} (warm collect)"] = rec["trace"]
+                if rec.get("trace_distribute"):
+                    traces[f"{name} (distribute, incl. compiles)"] = rec[
+                        "trace_distribute"
+                    ]
+
+    if configs:
+        print("### collect() configurations\n")
+        print("| step | metric | proofs/s | warm s | cold s | vs native C++ | vs CPython |")
+        print("|---|---|---|---|---|---|---|")
+        for name, r in configs:
+            print(
+                f"| {name} | {r['metric']} | {r.get('value', 0)} "
+                f"| {r.get('collect_warm_s', '—')} | {r.get('collect_cold_s', '—')} "
+                f"| {r.get('vs_baseline', '—')}x | {r.get('vs_cpython', '—')}x |"
+            )
+            if "error" in r:
+                print(f"|  | ERROR: {r['error'][:90]} | | | | | |")
+        print()
+
+    for name, tr in traces.items():
+        print(f"### per-phase breakdown: {name}, seconds\n")
+        print("| phase | seconds |")
+        print("|---|---|")
+        for phase, secs in sorted(tr.items(), key=lambda kv: -kv[1]):
+            print(f"| {phase} | {secs} |")
+        print()
+
+    if kernels:
+        print("### kernel sweep (modexp rows/s, real chip)\n")
+        print("| kernel | bits | exp bits | rows | groups | seconds | modexp/s |")
+        print("|---|---|---|---|---|---|---|")
+        for r in kernels:
+            print(
+                f"| {r['kernel']} | {r['bits']} | {r['exp_bits']} | {r['rows']} "
+                f"| {r.get('groups', '—')} | {r['seconds']} | {r['modexp_per_s']} |"
+            )
+        print()
+
+
+if __name__ == "__main__":
+    main()
