@@ -37,6 +37,13 @@ class BatchVerifier:
     ) -> List[bool]:
         raise NotImplementedError
 
+    def verify_pairs(self, pdl_items, range_items):
+        """Both families of the O(n^2) pair loop
+        (`src/refresh_message.rs:330-350`). Default: two family calls;
+        the TPU backend overrides to share one fused launch set, which
+        matters when small batches underfeed the chip."""
+        return self.verify_pdl(pdl_items), self.verify_range(range_items)
+
     def verify_ring_pedersen(
         self, items: Sequence[Tuple[RingPedersenProof, RingPedersenStatement]], m_security: int
     ) -> List[bool]:
@@ -108,7 +115,11 @@ class TracedVerifier:
             from ..utils.trace import phase
 
             def traced(items, *args, _attr=attr, _name=name, **kwargs):
-                with phase(f"collect.{_name}", items=len(items)):
+                # multi-list calls (verify_pairs) count every list's rows
+                rows = len(items) + sum(
+                    len(a) for a in args if isinstance(a, (list, tuple))
+                )
+                with phase(f"collect.{_name}", items=rows):
                     return _attr(items, *args, **kwargs)
 
             return traced

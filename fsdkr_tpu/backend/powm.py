@@ -288,14 +288,17 @@ def powm_columns(powm: BatchPowm, *columns):
     """Fuse several (bases, exps, moduli) columns into per-exponent-width
     batched launches and split the results back.
 
-    Columns are fused ONLY within the same bucketed exponent width: a
-    batched modexp costs sequential depth proportional to the widest
-    exponent in the batch, so a 256-bit-challenge column concatenated
-    with a 2048-bit column would do ~8x its necessary work riding the
-    wide launch. Same-width columns still share one launch (row count is
-    nearly free next to depth).
+    Columns are fused ONLY within the same bucketed exponent width AND
+    the same modulus limb width: a batched modexp costs sequential depth
+    proportional to the widest exponent in the batch, so a 256-bit-
+    challenge column concatenated with a 2048-bit column would do ~8x
+    its necessary work riding the wide launch — and a launch is limb-
+    sized by its widest modulus, so a mod-N~ (2048-bit) column fused
+    with a mod-n^2 (4096-bit) column would pay ~4x per modmul. Columns
+    matching on both still share one launch (row count is nearly free
+    next to depth).
     """
-    from ..ops.limbs import bucket_exp_bits
+    from ..ops.limbs import bucket_exp_bits, limbs_for_bits
 
     # Identical columns share one computation: the PDL and Alice range
     # provers both commit h1^x mod N~ over the same share column, so
@@ -323,7 +326,10 @@ def powm_columns(powm: BatchPowm, *columns):
             alias[col] = dup
             continue
         by_prefix.setdefault(prefix, []).append(col)
-        w = bucket_exp_bits(exps)
+        w = (
+            bucket_exp_bits(exps),
+            limbs_for_bits(max(m.bit_length() for m in moduli)) if moduli else 0,
+        )
         b, e, m, spans = flat.setdefault(w, ([], [], [], []))
         spans.append((col, len(b), len(b) + len(bases)))
         b += list(bases)

@@ -78,39 +78,34 @@ class TpuBatchVerifier(BatchVerifier):
         apply_mesh(config)
 
     # ------------------------------------------------------------------
-    def verify_pdl(self, items):
-        if not items:
-            return []
-        q3 = CURVE_ORDER**3
-
+    def _pdl_prepare(self, items):
+        """Recompute challenges; return (e_vec, the family's 5 modexp
+        columns). Column order matches _pdl_finish."""
         from ..utils.trace import phase
 
-        # sub-phases split host work (challenge hashing, int<->device
-        # conversion riding inside the launch wrappers) from the EC check,
-        # so on-chip traces show where a verify family's seconds go
         with phase("pdl.challenge", items=len(items)):
             e_vec = [
                 PDLwSlackProof._challenge(st, p.z, p.u1, p.u2, p.u3)
                 for p, st in items
             ]
+        nn_mod = [st.ek.nn for _, st in items]
+        nt_mod = [st.N_tilde for _, st in items]
+        cols = (
+            ([st.ciphertext for _, st in items], e_vec, nn_mod),
+            ([p.s2 for p, _ in items], [st.ek.n for _, st in items], nn_mod),
+            ([p.z for p, _ in items], e_vec, nt_mod),
+            ([st.h1 for _, st in items], [p.s1 for p, _ in items], nt_mod),
+            ([st.h2 for _, st in items], [p.s3 for p, _ in items], nt_mod),
+        )
+        return e_vec, cols
 
-        from .powm import powm_columns
+    def _pdl_finish(self, items, e_vec, results):
+        """Combine the 5 modexp column results into per-row verdicts."""
+        from ..utils.trace import phase
 
-        # mod n^2 columns fused into one launch, mod N~ columns into another
-        with phase("pdl.modexp_columns", items=5 * len(items)):
-            nn_mod = [st.ek.nn for _, st in items]
-            nt_mod = [st.N_tilde for _, st in items]
-            c_e, s2_n = powm_columns(
-                _modexp,
-                ([st.ciphertext for _, st in items], e_vec, nn_mod),
-                ([p.s2 for p, _ in items], [st.ek.n for _, st in items], nn_mod),
-            )
-            z_e, h1_s1, h2_s3 = powm_columns(
-                _modexp,
-                ([p.z for p, _ in items], e_vec, nt_mod),
-                ([st.h1 for _, st in items], [p.s1 for p, _ in items], nt_mod),
-                ([st.h2 for _, st in items], [p.s3 for p, _ in items], nt_mod),
-            )
+        c_e, s2_n, z_e, h1_s1, h2_s3 = results
+        nn_mod = [st.ek.nn for _, st in items]
+        nt_mod = [st.N_tilde for _, st in items]
         with phase("pdl.combine", items=len(items)):
             lhs2 = _modmul([p.u2 for p, _ in items], c_e, nn_mod)
             gs1 = [
@@ -130,6 +125,18 @@ class TpuBatchVerifier(BatchVerifier):
             ok3 = lhs3[idx] == rhs3[idx]
             out.append(None if (ok1 and ok2 and ok3) else (ok1, ok2, ok3))
         return out
+
+    def verify_pdl(self, items):
+        if not items:
+            return []
+        from ..utils.trace import phase
+
+        from .powm import powm_columns
+
+        e_vec, cols = self._pdl_prepare(items)
+        with phase("pdl.modexp_columns", items=5 * len(items)):
+            results = powm_columns(_modexp, *cols)
+        return self._pdl_finish(items, e_vec, results)
 
     def _pdl_u1_batch(self, items, e_vec) -> List[bool]:
         """u1 == s1*G - e*Q per row (`src/zk_pdl_with_slack.rs:124-127`),
@@ -195,43 +202,39 @@ class TpuBatchVerifier(BatchVerifier):
                 out[i] = vi
         return out
 
-    def verify_range(self, items):
-        if not items:
-            return []
+    def _range_prepare(self, items):
+        """The family's 5 modexp columns; order matches _range_finish."""
+        nn_mod = [ek.nn for _, _, ek, _ in items]
+        nt_mod = [dlog.N for _, _, _, dlog in items]
+        e_vec = [p.e for p, _, _, _ in items]
+        return (
+            ([p.z for p, _, _, _ in items], e_vec, nt_mod),
+            (
+                [dlog.g for _, _, _, dlog in items],
+                [p.s1 for p, _, _, _ in items],
+                nt_mod,
+            ),
+            (
+                [dlog.ni for _, _, _, dlog in items],
+                [p.s2 for p, _, _, _ in items],
+                nt_mod,
+            ),
+            ([c for _, c, _, _ in items], e_vec, nn_mod),
+            (
+                [p.s for p, _, _, _ in items],
+                [ek.n for _, _, ek, _ in items],
+                nn_mod,
+            ),
+        )
+
+    def _range_finish(self, items, results):
         q3 = CURVE_ORDER**3
 
         from ..utils.trace import phase
 
+        z_e, h1_s1, h2_s2, c_e, s_n = results
         nn_mod = [ek.nn for _, _, ek, _ in items]
         nt_mod = [dlog.N for _, _, _, dlog in items]
-        e_vec = [p.e for p, _, _, _ in items]
-
-        from .powm import powm_columns
-
-        with phase("range.modexp_columns", items=5 * len(items)):
-            z_e, h1_s1, h2_s2 = powm_columns(
-                _modexp,
-                ([p.z for p, _, _, _ in items], e_vec, nt_mod),
-                (
-                    [dlog.g for _, _, _, dlog in items],
-                    [p.s1 for p, _, _, _ in items],
-                    nt_mod,
-                ),
-                (
-                    [dlog.ni for _, _, _, dlog in items],
-                    [p.s2 for p, _, _, _ in items],
-                    nt_mod,
-                ),
-            )
-            c_e, s_n = powm_columns(
-                _modexp,
-                ([c for _, c, _, _ in items], e_vec, nn_mod),
-                (
-                    [p.s for p, _, _, _ in items],
-                    [ek.n for _, _, ek, _ in items],
-                    nn_mod,
-                ),
-            )
 
         with phase("range.combine", items=len(items)):
             w_part = _modmul(h1_s1, h2_s2, nt_mod)
@@ -260,6 +263,40 @@ class TpuBatchVerifier(BatchVerifier):
                     == proof.e
                 )
         return out
+
+    def verify_range(self, items):
+        if not items:
+            return []
+        from ..utils.trace import phase
+
+        from .powm import powm_columns
+
+        cols = self._range_prepare(items)
+        with phase("range.modexp_columns", items=5 * len(items)):
+            results = powm_columns(_modexp, *cols)
+        return self._range_finish(items, results)
+
+    def verify_pairs(self, pdl_items, range_items):
+        """Both pair-loop families through ONE fused launch set: all 10
+        modexp columns submitted together, so same-width columns across
+        families share launches (e.g. both 256-bit challenge columns).
+        Cuts the pair loop's sequential launch count roughly in half,
+        which dominates when small committees underfeed the chip."""
+        if not pdl_items or not range_items:
+            return super().verify_pairs(pdl_items, range_items)
+        from ..utils.trace import phase
+
+        from .powm import powm_columns
+
+        e_vec, pcols = self._pdl_prepare(pdl_items)
+        rcols = self._range_prepare(range_items)
+        n_rows = 5 * (len(pdl_items) + len(range_items))
+        with phase("pairs.modexp_columns", items=n_rows):
+            results = powm_columns(_modexp, *pcols, *rcols)
+        return (
+            self._pdl_finish(pdl_items, e_vec, results[:5]),
+            self._range_finish(range_items, results[5:]),
+        )
 
     # ------------------------------------------------------------------
     def verify_ring_pedersen(self, items, m_security):

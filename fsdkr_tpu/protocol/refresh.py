@@ -454,24 +454,32 @@ class RefreshMessage:
         def alive():
             return [s for s in range(S) if errors[s] is None]
 
-        def fused(call, items, spans):
-            """Run one fused backend launch; if a malformed session makes
+        def fused_multi(call, lists, spans):
+            """Run one fused backend launch over parallel item lists (all
+            sharing the same session spans); if a malformed session makes
             the whole batch raise (e.g. a crafted proof field the batch
             codec rejects), isolate per session so the bad session gets
             the error and the others still verify — the "a failing
-            session never blocks the others" guarantee."""
+            session never blocks the others" guarantee. Returns one
+            verdict list per input list."""
             try:
-                return call(items)
+                return call(*lists)
             except Exception:
-                out: list = [None] * len(items)
+                outs = tuple([None] * len(lst) for lst in lists)
                 for s, (lo, hi) in spans.items():
                     if errors[s] is not None:
                         continue
                     try:
-                        out[lo:hi] = call(items[lo:hi])
+                        res = call(*(lst[lo:hi] for lst in lists))
+                        for out, part in zip(outs, res):
+                            out[lo:hi] = part
                     except Exception as e:
                         errors[s] = e  # rows stay None; phases skip s
-                return out
+                return outs
+
+        def fused(call, items, spans):
+            """Single-list fused_multi."""
+            return fused_multi(lambda lst: (call(lst),), (items,), spans)[0]
 
         # ---- structure checks + fused Feldman validation --------------
         # (validate_collect semantics, reference :147-191)
@@ -540,8 +548,10 @@ class RefreshMessage:
             pair_spans[s] = (lo, len(pdl_items))
 
         if pdl_items:
-            pdl_verdicts = fused(backend.verify_pdl, pdl_items, pair_spans)
-            range_verdicts = fused(backend.verify_range, range_items, pair_spans)
+            # both families share one fused launch set (verify_pairs)
+            pdl_verdicts, range_verdicts = fused_multi(
+                backend.verify_pairs, (pdl_items, range_items), pair_spans
+            )
             # attribution in the reference's loop order (msg outer, i
             # inner; PDL before range — src/refresh_message.rs:330-350)
             for s, (start, _hi) in pair_spans.items():

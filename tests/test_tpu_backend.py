@@ -86,6 +86,39 @@ class TestFamilyParity:
         assert host == tpu
         assert host == [i != 4 for i in range(len(items))]
 
+    def test_pairs_fused_matches_per_family(self, refresh_round):
+        """verify_pairs (one cross-family fused launch set) must produce
+        the same verdict vectors as the separate family calls, including
+        tampered rows in each family."""
+        keys, msgs, _ = refresh_round
+        key = keys[0]
+        pdl_items = _pdl_items(keys, msgs, 3)
+        range_items = []
+        for msg in msgs:
+            for i in range(3):
+                range_items.append(
+                    (
+                        msg.range_proofs[i],
+                        msg.points_encrypted_vec[i],
+                        key.paillier_key_vec[i],
+                        key.h1_h2_n_tilde_vec[i],
+                    )
+                )
+        bad_p = dataclasses.replace(pdl_items[1][0], s2=pdl_items[1][0].s2 + 1)
+        pdl_items[1] = (bad_p, pdl_items[1][1])
+        bad_r = dataclasses.replace(
+            range_items[5][0], s1=range_items[5][0].s1 + 1
+        )
+        range_items[5] = (bad_r, *range_items[5][1:])
+
+        tpu = TpuBatchVerifier(TPU_CFG)
+        fused = tpu.verify_pairs(pdl_items, range_items)
+        assert fused[0] == tpu.verify_pdl(pdl_items)
+        assert fused[1] == tpu.verify_range(range_items)
+        host = HostBatchVerifier().verify_pairs(pdl_items, range_items)
+        assert fused[0] == host[0] and fused[1] == host[1]
+        assert fused[0][1] is not None and fused[1][5] is False
+
     def test_ring_pedersen(self, refresh_round):
         _, msgs, _ = refresh_round
         items = [(m.ring_pedersen_proof, m.ring_pedersen_statement) for m in msgs]

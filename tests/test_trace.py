@@ -53,7 +53,7 @@ class TestProtocolIntegration:
         for expected in (
             "distribute.prove_stage1",
             "distribute.prove_stage2",
-            "collect.verify_pdl",
+            "collect.verify_pairs",  # PDL + range, one fused launch set
             "collect.verify_ring_pedersen",
             "collect.validate_feldman",
         ):
