@@ -176,8 +176,38 @@ def _modexp_kernel(base, exp, n, n_prime, r2, one_mont, *, exp_bits):
     return mont_mul_limbs(acc, one, n, n_prime)
 
 
-@partial(jax.jit, static_argnames=("exp_bits",))
-def _shared_modexp_kernel(base, exp, n, n_prime, r2, one_mont, powers=None, *, exp_bits):
+def _comb_tree_chunk(
+    w_cnt: int, rows: int, width: int, table_rows: int = 0
+) -> int:
+    """Tree-accumulation chunk size (windows per chunk, power of two).
+
+    A comb row's W window products are independent, so a chunk of C
+    windows' selected entries can tree-reduce in log2(C) MontMul levels
+    instead of C sequential table multiplies — the depth reduction that
+    matters when small committees leave the chip latency-bound (total
+    multiply count is unchanged, so saturated batches are unaffected).
+    C is capped so the materialized (C, rows, width) selection stays
+    within an element budget (FSDKR_COMB_TREE_BUDGET, default 2^24 u32
+    lanes ~ 64 MB); FSDKR_COMB_TREE=0 disables chunking (C=1 == the
+    sequential ladder).
+    """
+    import os
+
+    if os.environ.get("FSDKR_COMB_TREE", "1") in ("", "0"):
+        return 1
+    budget = int(os.environ.get("FSDKR_COMB_TREE_BUDGET", str(1 << 24)))
+    c = budget // max(1, rows * width)
+    if table_rows:  # fly-built tables: 16 entries per window-group row
+        c = min(c, budget // max(1, 16 * table_rows * width))
+    if c < 2:
+        return 1
+    c = 1 << (c.bit_length() - 1)
+    w_pow2 = 1 << ((w_cnt - 1).bit_length())
+    return min(c, w_pow2)
+
+
+@partial(jax.jit, static_argnames=("exp_bits", "tree_chunk"))
+def _shared_modexp_kernel(base, exp, n, n_prime, r2, one_mont, powers=None, *, exp_bits, tree_chunk=1):
     """result[g, m] = base[g]^exp[g, m] mod n[g] — fixed-base comb.
 
     The O(n^2) verification loop has whole columns whose rows share one
@@ -252,27 +282,78 @@ def _shared_modexp_kernel(base, exp, n, n_prime, r2, one_mont, powers=None, *, e
         axis=0,
     )
 
-    # Accumulation: one table multiply per window on the (G*M)-row batch.
+    # Accumulation on the (G*M)-row batch. With tree chunking (C > 1),
+    # each chunk of C windows' selected entries reduces in log2(C)
+    # MontMul levels; padded windows read zero exponent digits and
+    # select table entry 0 = one_mont, the MontMul identity, so
+    # non-power-of-two window counts stay exact.
     n_rows = jnp.broadcast_to(n[:, None], (g, m, k)).reshape(g * m, k)
     np_rows = jnp.broadcast_to(n_prime[:, None], (g, m)).reshape(g * m)
     acc0 = jnp.broadcast_to(one_mont[:, None], (g, m, k)).reshape(g * m, k)
-    idx = jnp.arange(1 << _WINDOW, dtype=_U32)[:, None, None, None]
+    C = tree_chunk
 
-    def acc_step(w, acc):
-        shift = _WINDOW * w
-        limb = lax.dynamic_index_in_dim(
-            exp, shift // LIMB_BITS, axis=2, keepdims=False
-        )  # (G, M)
-        d = (limb >> (shift % LIMB_BITS)) & ((1 << _WINDOW) - 1)
-        entries = lax.dynamic_index_in_dim(table, w, axis=1, keepdims=False)
-        # branchless per-row pick of entries[d[g,m], g, :] -> (G, M, K)
-        sel = jnp.sum(
-            jnp.where(d[None, :, :, None] == idx, entries[:, :, None, :], jnp.uint32(0)),
-            axis=0,
-        )
-        return mont_mul_limbs(acc, sel.reshape(g * m, k), n_rows, np_rows)
+    if C == 1:
+        idx = jnp.arange(1 << _WINDOW, dtype=_U32)[:, None, None, None]
 
-    acc = lax.fori_loop(0, w_cnt, acc_step, acc0)
+        def acc_step(w, acc):
+            shift = _WINDOW * w
+            limb = lax.dynamic_index_in_dim(
+                exp, shift // LIMB_BITS, axis=2, keepdims=False
+            )  # (G, M)
+            d = (limb >> (shift % LIMB_BITS)) & ((1 << _WINDOW) - 1)
+            entries = lax.dynamic_index_in_dim(table, w, axis=1, keepdims=False)
+            # branchless per-row pick of entries[d[g,m], g, :] -> (G, M, K)
+            sel = jnp.sum(
+                jnp.where(d[None, :, :, None] == idx, entries[:, :, None, :], jnp.uint32(0)),
+                axis=0,
+            )
+            return mont_mul_limbs(acc, sel.reshape(g * m, k), n_rows, np_rows)
+
+        acc = lax.fori_loop(0, w_cnt, acc_step, acc0)
+    else:
+        n_chunks = -(-w_cnt // C)
+        w_pad = n_chunks * C
+        el_pad = w_pad * _WINDOW // LIMB_BITS  # LIMB_BITS % _WINDOW == 0
+        if el_pad > exp.shape[2]:
+            exp = jnp.pad(exp, ((0, 0), (0, 0), (0, el_pad - exp.shape[2])))
+        if w_pad > w_cnt:  # entry 0 of every window is one_mont
+            table = jnp.pad(
+                table, ((0, 0), (0, w_pad - w_cnt), (0, 0), (0, 0)), mode="edge"
+            )
+        mask = jnp.uint32((1 << _WINDOW) - 1)
+        ws0 = jnp.arange(C, dtype=jnp.int32)
+        idx5 = jnp.arange(1 << _WINDOW, dtype=_U32)[:, None, None, None, None]
+
+        def chunk_step(ci, acc):
+            shifts = _WINDOW * (ci * C + ws0)  # (C,)
+            limbs = jnp.take(exp, shifts // LIMB_BITS, axis=2)  # (G, M, C)
+            sh = (shifts % LIMB_BITS).astype(limbs.dtype)
+            d = (limbs >> sh[None, None, :]) & mask
+            entries = lax.dynamic_slice_in_dim(
+                table, ci * C, C, axis=1
+            )  # (16, C, G, K)
+            dt = d.transpose(2, 0, 1)  # (C, G, M)
+            sel = jnp.sum(
+                jnp.where(
+                    dt[None, :, :, :, None] == idx5,
+                    entries[:, :, :, None, :],
+                    jnp.uint32(0),
+                ),
+                axis=0,
+            )  # (C, G, M, K)
+            x = sel.reshape(C, g * m, k)
+            lvl = C
+            while lvl > 1:
+                half = lvl // 2
+                a = x[0:lvl:2].reshape(half * g * m, k)
+                b = x[1:lvl:2].reshape(half * g * m, k)
+                nn = jnp.tile(n_rows, (half, 1))
+                pp = jnp.tile(np_rows, (half,))
+                x = mont_mul_limbs(a, b, nn, pp).reshape(half, g * m, k)
+                lvl = half
+            return mont_mul_limbs(acc, x[0], n_rows, np_rows)
+
+        acc = lax.fori_loop(0, n_chunks, chunk_step, acc0)
     one = jnp.zeros_like(acc).at[:, 0].set(1)
     out = mont_mul_limbs(acc, one, n_rows, np_rows)
     return out.reshape(g, m, k)
@@ -425,10 +506,18 @@ def shared_base_modexp(
     if mesh is not None and g_cnt % int(mesh.devices.size) == 0:
         from ..parallel.shard_kernels import sharded_shared_modexp_fn
 
-        kernel = sharded_shared_modexp_fn(mesh, exp_bits, powers is not None)
+        kernel = sharded_shared_modexp_fn(
+            mesh, exp_bits, powers is not None,
+            tree_chunk=_comb_tree_chunk(
+                exp_bits // _WINDOW, g_cnt * m_max, num_limbs
+            ),
+        )
         out = kernel(*args, powers) if powers is not None else kernel(*args)
     else:
-        out = _shared_modexp_kernel(*args, powers, exp_bits=exp_bits)
+        out = _shared_modexp_kernel(
+            *args, powers, exp_bits=exp_bits,
+            tree_chunk=_comb_tree_chunk(exp_bits // _WINDOW, g_cnt * m_max, num_limbs),
+        )
     flat = limbs_to_ints(np.asarray(out).reshape(g_cnt * m_max, num_limbs))
     return [
         flat[g * m_max : g * m_max + len(exps_per_group[g])] for g in range(g_cnt)

@@ -558,10 +558,10 @@ def _rns_modexp_full_pallas(
     )
 
 
-@partial(jax.jit, static_argnames=("exp_bits", "k", "pallas_mode", "device_ladder"))
+@partial(jax.jit, static_argnames=("exp_bits", "k", "pallas_mode", "device_ladder", "tree_chunk"))
 def _rns_shared_modexp_kernel(
     powers_limbs, exp, a2n_limbs, c1_A, N_Bmr, consts_arrays, *, exp_bits, k,
-    pallas_mode=0, device_ladder=False,
+    pallas_mode=0, device_ladder=False, tree_chunk=1,
 ):
     """Fixed-base comb over RNS MontMuls: groups share (base, modulus).
 
@@ -654,54 +654,136 @@ def _rns_shared_modexp_kernel(
     one_m_g = _rns_mont_mul(one_g, a2n_res, consts_g)  # (G, C)
 
     # Per-window 16-entry tables are built ON THE FLY inside the window
-    # loop from powers[w] (log-depth products on G-row batches): a
-    # materialized all-windows table is (16, W, G, C) — terabytes at the
-    # n=256 ring-Pedersen shape — while the fly-built one is (16, G, C)
-    # live at a time, and the extra ~14 G-row products per window are
-    # ~5% of the (G*M)-row accumulation work.
-    def window_table(p1):
-        def mul_many(pairs, cc):
-            a = jnp.concatenate([x for x, _ in pairs], axis=0)
-            b = jnp.concatenate([y for _, y in pairs], axis=0)
-            out = _rns_mont_mul(a, b, cc)
-            return [out[i * g : (i + 1) * g] for i in range(len(pairs))]
+    # loop from powers[w] (log-depth products): a materialized
+    # all-windows table is (16, W, G, C) — terabytes at the n=256
+    # ring-Pedersen shape — while a fly-built one is (16, reps*G, C)
+    # live at a time (reps = 1 sequential, tree_chunk for a tree chunk),
+    # and the extra ~14 products per window are ~5% of the (G*M)-row
+    # accumulation work. One builder serves both paths so their product
+    # ladders cannot diverge.
+    def make_table_fn(reps):
+        rows = reps * g
+        cc1 = consts_g if reps == 1 else consts_rep(reps)
+        cc2, cc4, cc7 = (
+            consts_rep(2 * reps), consts_rep(4 * reps), consts_rep(7 * reps)
+        )
+        one_rows = jnp.broadcast_to(one_m_g[None], (reps, g, c)).reshape(
+            rows, c
+        )
 
-        p2 = _rns_mont_mul(p1, p1, consts_g)
-        p3, p4 = mul_many([(p2, p1), (p2, p2)], consts_2g)
-        p5, p6, p7, p8 = mul_many(
-            [(p4, p1), (p4, p2), (p4, p3), (p4, p4)], consts_4g
-        )
-        p9, p10, p11, p12, p13, p14, p15 = mul_many(
-            [(p8, p1), (p8, p2), (p8, p3), (p8, p4), (p8, p5), (p8, p6), (p8, p7)],
-            consts_7g,
-        )
-        return jnp.stack(
-            [one_m_g, p1, p2, p3, p4, p5, p6, p7, p8,
-             p9, p10, p11, p12, p13, p14, p15],
-            axis=0,
-        )  # (16, G, C)
+        def table_fn(p1):  # p1: (rows, C) -> (16, rows, C)
+            def mul_many(pairs, cc):
+                a = jnp.concatenate([x for x, _ in pairs], axis=0)
+                b = jnp.concatenate([y for _, y in pairs], axis=0)
+                out = _rns_mont_mul(a, b, cc)
+                return [
+                    out[i * rows : (i + 1) * rows] for i in range(len(pairs))
+                ]
+
+            p2 = _rns_mont_mul(p1, p1, cc1)
+            p3, p4 = mul_many([(p2, p1), (p2, p2)], cc2)
+            p5, p6, p7, p8 = mul_many(
+                [(p4, p1), (p4, p2), (p4, p3), (p4, p4)], cc4
+            )
+            p9, p10, p11, p12, p13, p14, p15 = mul_many(
+                [(p8, p1), (p8, p2), (p8, p3), (p8, p4), (p8, p5), (p8, p6),
+                 (p8, p7)],
+                cc7,
+            )
+            return jnp.stack(
+                [one_rows, p1, p2, p3, p4, p5, p6, p7, p8,
+                 p9, p10, p11, p12, p13, p14, p15],
+                axis=0,
+            )
+
+        return table_fn
 
     acc0 = jnp.broadcast_to(one_m_g[:, None], (g, m, c)).reshape(g * m, c)
-    idx = jnp.arange(1 << WINDOW_BITS, dtype=_U32)[:, None, None, None]
 
-    def acc_step(w, acc):
-        shift = WINDOW_BITS * w
-        limb = lax.dynamic_index_in_dim(
-            exp, shift // LIMB_BITS, axis=2, keepdims=False
-        )  # (G, M)
-        d = (limb >> (shift % LIMB_BITS)) & ((1 << WINDOW_BITS) - 1)
-        entries = window_table(
-            lax.dynamic_index_in_dim(powers, w, axis=0, keepdims=False)
-        )  # (16, G, C)
-        sel = jnp.sum(
-            jnp.where(
-                d[None, :, :, None] == idx, entries[:, :, None, :], jnp.uint32(0)
-            ),
-            axis=0,
-        )
-        return _rns_mont_mul(acc, sel.reshape(g * m, c), consts_gm)
+    CH = tree_chunk
 
-    acc = lax.fori_loop(0, exp_bits // WINDOW_BITS, acc_step, acc0)
+    if CH == 1:
+        idx = jnp.arange(1 << WINDOW_BITS, dtype=_U32)[:, None, None, None]
+
+        def acc_step(w, acc):
+            shift = WINDOW_BITS * w
+            limb = lax.dynamic_index_in_dim(
+                exp, shift // LIMB_BITS, axis=2, keepdims=False
+            )  # (G, M)
+            d = (limb >> (shift % LIMB_BITS)) & ((1 << WINDOW_BITS) - 1)
+            entries = window_table(
+                lax.dynamic_index_in_dim(powers, w, axis=0, keepdims=False)
+            )  # (16, G, C)
+            sel = jnp.sum(
+                jnp.where(
+                    d[None, :, :, None] == idx, entries[:, :, None, :], jnp.uint32(0)
+                ),
+                axis=0,
+            )
+            return _rns_mont_mul(acc, sel.reshape(g * m, c), consts_gm)
+
+        acc = lax.fori_loop(0, w_cnt, acc_step, acc0)
+    else:
+        # Tree chunking: CH windows' tables built in one batched set of
+        # log-depth products, their selected entries reduced in log2(CH)
+        # MontMul levels. Padded windows read zero exponent digits and
+        # select entry 0 = Montgomery one (the MontMul identity), so
+        # non-power-of-two window counts stay exact.
+        n_chunks = -(-w_cnt // CH)
+        w_pad = n_chunks * CH
+        el_pad = w_pad * WINDOW_BITS // LIMB_BITS
+        if el_pad > exp.shape[2]:
+            exp = jnp.pad(exp, ((0, 0), (0, 0), (0, el_pad - exp.shape[2])))
+        if w_pad > w_cnt:
+            powers = jnp.pad(
+                powers, ((0, w_pad - w_cnt), (0, 0), (0, 0)), mode="edge"
+            )
+        table_chunk = make_table_fn(CH)
+
+        # per-level consts for the tree reductions (static level ladder)
+        consts_lvl = {}
+        half = CH // 2
+        while half >= 1:
+            consts_lvl[half] = consts_for(
+                jnp.tile(c1_gm, (half, 1)), jnp.tile(n_gm, (half, 1))
+            )
+            half //= 2
+
+        mask = jnp.uint32((1 << WINDOW_BITS) - 1)
+        ws0 = jnp.arange(CH, dtype=jnp.int32)
+        idx5 = jnp.arange(1 << WINDOW_BITS, dtype=_U32)[:, None, None, None, None]
+
+        def chunk_step(ci, acc):
+            shifts = WINDOW_BITS * (ci * CH + ws0)  # (CH,)
+            limbs = jnp.take(exp, shifts // LIMB_BITS, axis=2)  # (G, M, CH)
+            sh = (shifts % LIMB_BITS).astype(limbs.dtype)
+            d = (limbs >> sh[None, None, :]) & mask
+            p_chunk = lax.dynamic_slice_in_dim(powers, ci * CH, CH, axis=0)
+            entries = table_chunk(p_chunk.reshape(CH * g, c)).reshape(
+                16, CH, g, c
+            )
+            dt = d.transpose(2, 0, 1)  # (CH, G, M)
+            sel = jnp.sum(
+                jnp.where(
+                    dt[None, :, :, :, None] == idx5,
+                    entries[:, :, :, None, :],
+                    jnp.uint32(0),
+                ),
+                axis=0,
+            )  # (CH, G, M, C)
+            x = sel.reshape(CH, g * m, c)
+            lvl = CH
+            while lvl > 1:
+                half = lvl // 2
+                a = x[0:lvl:2].reshape(half * g * m, c)
+                b = x[1:lvl:2].reshape(half * g * m, c)
+                x = _rns_mont_mul(a, b, consts_lvl[half]).reshape(
+                    half, g * m, c
+                )
+                lvl = half
+            return _rns_mont_mul(acc, x[0], consts_gm)
+
+        acc = lax.fori_loop(0, n_chunks, chunk_step, acc0)
     one_rows = jnp.ones((g * m, c), _U32)
     return _rns_mont_mul(acc, one_rows, consts_gm)
 
@@ -797,16 +879,22 @@ def rns_modexp_shared(
     if mesh is not None and g_cnt % int(mesh.devices.size) == 0:
         from ..parallel.shard_kernels import sharded_rns_shared_modexp_fn
 
+        from .montgomery import _comb_tree_chunk
+
         out_res = sharded_rns_shared_modexp_fn(
-            mesh, exp_bits, k, _pallas_mode(), device_ladder
+            mesh, exp_bits, k, _pallas_mode(), device_ladder,
+            tree_chunk=_comb_tree_chunk(w_cnt, g_cnt * m_max, 2 * k + 1, table_rows=g_cnt),
         )(*args)
     else:
+        from .montgomery import _comb_tree_chunk
+
         out_res = _rns_shared_modexp_kernel(
             *args,
             exp_bits=exp_bits,
             k=k,
             pallas_mode=_pallas_mode(),
             device_ladder=device_ladder,
+            tree_chunk=_comb_tree_chunk(w_cnt, g_cnt * m_max, 2 * k + 1, table_rows=g_cnt),
         )
     # device CRT exit over all (group, row) cells at once
     ec = rb.exit_consts

@@ -75,7 +75,7 @@ def sharded_modmul_fn(mesh):
 
 
 @lru_cache(maxsize=128)
-def sharded_shared_modexp_fn(mesh, exp_bits: int, with_powers: bool):
+def sharded_shared_modexp_fn(mesh, exp_bits: int, with_powers: bool, tree_chunk: int = 1):
     """Comb kernel sharded over the GROUP axis: each device owns whole
     (base, modulus) groups, so the per-group ladder/table work never
     crosses devices."""
@@ -94,7 +94,8 @@ def sharded_shared_modexp_fn(mesh, exp_bits: int, with_powers: bool):
 
         def kernel(base, exp, n, n_prime, r2, one_mont, powers):
             return _shared_modexp_kernel.__wrapped__(
-                base, exp, n, n_prime, r2, one_mont, powers, exp_bits=exp_bits
+                base, exp, n, n_prime, r2, one_mont, powers,
+                exp_bits=exp_bits, tree_chunk=tree_chunk,
             )
 
         in_specs = base_specs + (P(None, row, None),)  # powers (W, G, K)
@@ -102,7 +103,8 @@ def sharded_shared_modexp_fn(mesh, exp_bits: int, with_powers: bool):
 
         def kernel(base, exp, n, n_prime, r2, one_mont):
             return _shared_modexp_kernel.__wrapped__(
-                base, exp, n, n_prime, r2, one_mont, None, exp_bits=exp_bits
+                base, exp, n, n_prime, r2, one_mont, None,
+                exp_bits=exp_bits, tree_chunk=tree_chunk,
             )
 
         in_specs = base_specs
@@ -146,7 +148,8 @@ def sharded_rns_modexp_fn(mesh, exp_bits: int, k: int, pallas_mode: int = 0):
 
 @lru_cache(maxsize=128)
 def sharded_rns_shared_modexp_fn(
-    mesh, exp_bits: int, k: int, pallas_mode: int = 0, device_ladder: bool = False
+    mesh, exp_bits: int, k: int, pallas_mode: int = 0,
+    device_ladder: bool = False, tree_chunk: int = 1,
 ):
     """RNS comb sharded over groups. The kernel returns (G*M, C) rows in
     group-major order, so a leading-axis shard over G devices concatenates
@@ -160,6 +163,7 @@ def sharded_rns_shared_modexp_fn(
         k=k,
         pallas_mode=pallas_mode,
         device_ladder=device_ladder,
+        tree_chunk=tree_chunk,
     )
     sm = jax.shard_map(
         kernel,
