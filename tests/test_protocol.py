@@ -163,3 +163,30 @@ class TestWireTamper:
         msgs[1].points_encrypted_vec[0] += 1  # tamper
         with pytest.raises(FsDkrError):
             RefreshMessage.collect(msgs, keys[0], dks[0], (), CFG)
+
+
+@pytest.mark.slow
+def test_full_size_refresh_end_to_end():
+    """One complete refresh at the reference's production parameters
+    (2048-bit Paillier, M=256 ring-Pedersen, 11 correct-key rounds,
+    `/root/reference/src/lib.rs:26-27`) through the batched TPU backend:
+    secret preserved, shares rotated. Minutes on the single-core CPU
+    platform — excluded from quick runs, the bench path exercises the
+    same parameters on the real chip."""
+    from fsdkr_tpu.config import ProtocolConfig
+
+    cfg = ProtocolConfig()  # full-size defaults
+    tpu = cfg.with_backend("tpu")
+    t, n = 1, 3
+    keys = simulate_keygen(t, n, cfg)
+    old = [k.keys_linear.x_i for k in keys]
+
+    simulate_dkr(keys, tpu)
+
+    params = vss.ShamirSecretSharing(t, n)
+    new = [k.keys_linear.x_i for k in keys]
+    assert (
+        vss.reconstruct(params, [0, 1], old[:2]).v
+        == vss.reconstruct(params, [1, 2], new[1:]).v
+    )
+    assert all(o != w for o, w in zip(old, new))
