@@ -208,7 +208,7 @@ def main():
             (2048, 2048, 16, 256),  # ring-Pedersen @ n=16
             (2048, 256, 16, 64),
         ]
-        batch_sweep = [128, 512, 2048, 8192]
+        batch_sweep = [64, 128, 512, 2048, 8192]
     else:
         generic_points = [
             (2048, 256, 1024),
