@@ -38,6 +38,7 @@ from ..ops.limbs import limbs_for_bits
 from ..proofs import alice_range, correct_key
 from ..proofs.pdl_slack import PDLwSlackProof
 from ..proofs.ring_pedersen import RingPedersenProof
+from ..utils.trace import phase
 from .batch_verifier import BatchVerifier, HostBatchVerifier
 
 
@@ -79,10 +80,8 @@ class TpuBatchVerifier(BatchVerifier):
 
     # ------------------------------------------------------------------
     def _pdl_prepare(self, items):
-        """Recompute challenges; return (e_vec, the family's 5 modexp
-        columns). Column order matches _pdl_finish."""
-        from ..utils.trace import phase
-
+        """Recompute challenges; return (the family's 5 modexp columns,
+        carry state for _pdl_finish). Column order matches _pdl_finish."""
         with phase("pdl.challenge", items=len(items)):
             e_vec = [
                 PDLwSlackProof._challenge(st, p.z, p.u1, p.u2, p.u3)
@@ -97,15 +96,12 @@ class TpuBatchVerifier(BatchVerifier):
             ([st.h1 for _, st in items], [p.s1 for p, _ in items], nt_mod),
             ([st.h2 for _, st in items], [p.s3 for p, _ in items], nt_mod),
         )
-        return e_vec, cols
+        return cols, (e_vec, nn_mod, nt_mod)
 
-    def _pdl_finish(self, items, e_vec, results):
+    def _pdl_finish(self, items, state, results):
         """Combine the 5 modexp column results into per-row verdicts."""
-        from ..utils.trace import phase
-
+        e_vec, nn_mod, nt_mod = state
         c_e, s2_n, z_e, h1_s1, h2_s3 = results
-        nn_mod = [st.ek.nn for _, st in items]
-        nt_mod = [st.N_tilde for _, st in items]
         with phase("pdl.combine", items=len(items)):
             lhs2 = _modmul([p.u2 for p, _ in items], c_e, nn_mod)
             gs1 = [
@@ -129,14 +125,12 @@ class TpuBatchVerifier(BatchVerifier):
     def verify_pdl(self, items):
         if not items:
             return []
-        from ..utils.trace import phase
-
         from .powm import powm_columns
 
-        e_vec, cols = self._pdl_prepare(items)
+        cols, state = self._pdl_prepare(items)
         with phase("pdl.modexp_columns", items=5 * len(items)):
             results = powm_columns(_modexp, *cols)
-        return self._pdl_finish(items, e_vec, results)
+        return self._pdl_finish(items, state, results)
 
     def _pdl_u1_batch(self, items, e_vec) -> List[bool]:
         """u1 == s1*G - e*Q per row (`src/zk_pdl_with_slack.rs:124-127`),
@@ -203,7 +197,8 @@ class TpuBatchVerifier(BatchVerifier):
         return out
 
     def _range_prepare(self, items):
-        """The family's 5 modexp columns; order matches _range_finish."""
+        """Return (the family's 5 modexp columns, carry state for
+        _range_finish). Column order matches _range_finish."""
         nn_mod = [ek.nn for _, _, ek, _ in items]
         nt_mod = [dlog.N for _, _, _, dlog in items]
         e_vec = [p.e for p, _, _, _ in items]
@@ -225,16 +220,13 @@ class TpuBatchVerifier(BatchVerifier):
                 [ek.n for _, _, ek, _ in items],
                 nn_mod,
             ),
-        )
+        ), (nn_mod, nt_mod)
 
-    def _range_finish(self, items, results):
+    def _range_finish(self, items, mods, results):
         q3 = CURVE_ORDER**3
 
-        from ..utils.trace import phase
-
+        nn_mod, nt_mod = mods
         z_e, h1_s1, h2_s2, c_e, s_n = results
-        nn_mod = [ek.nn for _, _, ek, _ in items]
-        nt_mod = [dlog.N for _, _, _, dlog in items]
 
         with phase("range.combine", items=len(items)):
             w_part = _modmul(h1_s1, h2_s2, nt_mod)
@@ -267,14 +259,12 @@ class TpuBatchVerifier(BatchVerifier):
     def verify_range(self, items):
         if not items:
             return []
-        from ..utils.trace import phase
-
         from .powm import powm_columns
 
-        cols = self._range_prepare(items)
+        cols, mods = self._range_prepare(items)
         with phase("range.modexp_columns", items=5 * len(items)):
             results = powm_columns(_modexp, *cols)
-        return self._range_finish(items, results)
+        return self._range_finish(items, mods, results)
 
     def verify_pairs(self, pdl_items, range_items):
         """Both pair-loop families through ONE fused launch set: all 10
@@ -284,26 +274,22 @@ class TpuBatchVerifier(BatchVerifier):
         which dominates when small committees underfeed the chip."""
         if not pdl_items or not range_items:
             return super().verify_pairs(pdl_items, range_items)
-        from ..utils.trace import phase
-
         from .powm import powm_columns
 
-        e_vec, pcols = self._pdl_prepare(pdl_items)
-        rcols = self._range_prepare(range_items)
+        pcols, state = self._pdl_prepare(pdl_items)
+        rcols, rmods = self._range_prepare(range_items)
         n_rows = 5 * (len(pdl_items) + len(range_items))
         with phase("pairs.modexp_columns", items=n_rows):
             results = powm_columns(_modexp, *pcols, *rcols)
         return (
-            self._pdl_finish(pdl_items, e_vec, results[:5]),
-            self._range_finish(range_items, results[5:]),
+            self._pdl_finish(pdl_items, state, results[:5]),
+            self._range_finish(range_items, rmods, results[5:]),
         )
 
     # ------------------------------------------------------------------
     def verify_ring_pedersen(self, items, m_security):
         if not items:
             return []
-        from ..utils.trace import phase
-
         bases, exps, moduli, rhs_a, rhs_s = [], [], [], [], []
         shapes_ok = []
         with phase("ringped.challenge", items=len(items)):
@@ -343,8 +329,6 @@ class TpuBatchVerifier(BatchVerifier):
         if not items:
             return []
         import math
-
-        from ..utils.trace import phase
 
         bases, exps, moduli, want = [], [], [], []
         gates = []
@@ -388,8 +372,6 @@ class TpuBatchVerifier(BatchVerifier):
         if not items:
             return []
         from ..proofs.composite_dlog import CompositeDLogProof
-        from ..utils.trace import phase
-
         with phase("composite_dlog.challenge", items=len(items)):
             e_vec = [
                 CompositeDLogProof._challenge(p.x_commit, st) for p, st in items
