@@ -3,6 +3,7 @@
 chain `ops.rns._rns_mont_mul`, and the full modexp pipeline with the
 Pallas path forced must match CPython pow."""
 
+import math
 import secrets
 
 import jax.numpy as jnp
@@ -25,8 +26,15 @@ def _consts_arrays(rb):
     return rns._prep_consts(rb)
 
 
-def _row_setup(rb, rows):
-    moduli = [secrets.randbits(BITS) | (1 << (BITS - 1)) | 1 for _ in range(rows)]
+def _row_setup(rb, rows, bits=BITS):
+    # coprime to every channel prime: colliding moduli take the
+    # production per-row fallback, not the kernel under test
+    channel_prod = rb.A * rb.B * rb.m_r
+    moduli = []
+    while len(moduli) < rows:
+        n = secrets.randbits(bits) | (1 << (bits - 1)) | 1
+        if math.gcd(n, channel_prod) == 1:
+            moduli.append(n)
     c1 = np.zeros((rows, rb.k), np.uint32)
     n_bmr = np.zeros((rows, rb.k + 1), np.uint32)
     for r, n in enumerate(moduli):
@@ -103,19 +111,7 @@ class TestPallasMontMul:
         rb = rns.rns_bases_for_bits(bits, limbs_for_bits(bits))
         assert rb.k > 257  # the premise of this regression test
         rows = 8
-        moduli = [
-            secrets.randbits(bits) | (1 << (bits - 1)) | 1 for _ in range(rows)
-        ]
-        c1 = np.zeros((rows, rb.k), np.uint32)
-        n_bmr = np.zeros((rows, rb.k + 1), np.uint32)
-        for r, n in enumerate(moduli):
-            for i, a in enumerate(rb.A_primes):
-                c1[r, i] = (-pow(n, -1, a)) % a * int(rb.Ai_inv[i]) % a
-            for j, b in enumerate(rb.B_primes):
-                n_bmr[r, j] = n % b
-            n_bmr[r, rb.k] = n % rb.m_r
-        c1 = jnp.asarray(c1)
-        n_bmr = jnp.asarray(n_bmr)
+        moduli, c1, n_bmr = _row_setup(rb, rows, bits=bits)
         # worst-case-ish inputs: residues near the channel maxima
         x = jnp.asarray(
             np.array(
