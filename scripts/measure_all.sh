@@ -51,6 +51,9 @@ run n128 6000 BENCH_N=128 BENCH_T=64 FSDKR_TRACE=1 python bench.py
 run n256 9000 BENCH_N=256 BENCH_T=128 FSDKR_TRACE=1 python bench.py
 # kernel-level sweep (sets router thresholds; experimental points last)
 run sweep_quick 3600 python scripts/bench_kernels.py quick
-# fallback datapoint if the RNS path misbehaves on the real chip
-run n16_cios 2400 FSDKR_RNS_MIN_ROWS=999999999 FSDKR_TRACE=1 python bench.py
+# fallback datapoint if the RNS path misbehaves on the real chip —
+# also disables tree-comb, i.e. exactly the round-2 known-good kernels
+run n16_cios 2400 FSDKR_RNS_MIN_ROWS=999999999 FSDKR_COMB_TREE=0 FSDKR_TRACE=1 python bench.py
+# and the inverse A/B: RNS everywhere but sequential comb ladders
+run n16_notree 2400 FSDKR_COMB_TREE=0 FSDKR_TRACE=1 python bench.py
 echo "=== battery done ==="
