@@ -703,6 +703,7 @@ def _rns_shared_modexp_kernel(
     CH = tree_chunk
 
     if CH == 1:
+        window_table = make_table_fn(1)  # (G, C) -> (16, G, C)
         idx = jnp.arange(1 << WINDOW_BITS, dtype=_U32)[:, None, None, None]
 
         def acc_step(w, acc):

@@ -96,6 +96,30 @@ class TestModexp:
         assert got == pow(b, e, n)
 
 
+def test_shared_comb_sequential_ladder(monkeypatch):
+    """FSDKR_COMB_TREE=0 forces tree_chunk=1, the sequential per-window
+    accumulation branch of _rns_shared_modexp_kernel. It must agree with
+    the default tree-chunked path and the host oracle (regression: the
+    round-3 refactor left window_table unbound in this branch)."""
+    import random
+
+    from fsdkr_tpu.ops import rns
+
+    rng = random.Random(47)
+    bits = 512
+    gmods = [rng.getrandbits(bits) | (1 << (bits - 1)) | 1 for _ in range(3)]
+    gbases = [rng.getrandbits(bits - 1) for _ in range(3)]
+    gexps = [[rng.getrandbits(96) for _ in range(2)] for _ in range(3)]
+    want = [
+        [pow(b % n, e, n) for e in grp]
+        for b, grp, n in zip(gbases, gexps, gmods)
+    ]
+    monkeypatch.setenv("FSDKR_COMB_TREE", "0")
+    assert rns.rns_modexp_shared(gbases, gexps, gmods, bits) == want
+    monkeypatch.delenv("FSDKR_COMB_TREE")
+    assert rns.rns_modexp_shared(gbases, gexps, gmods, bits) == want
+
+
 def test_shared_comb_device_ladder(monkeypatch):
     """Above _DEVICE_LADDER_MIN_GROUPS the comb builds its power ladder
     on the device batch; results must match the host-ladder path / pow."""
