@@ -102,9 +102,14 @@ class NewPartyUnassignedIndexError(FsDkrError):
 
 
 class BroadcastedPublicKeyError(FsDkrError):
-    # reference: src/error.rs:53
-    def __init__(self) -> None:
-        super().__init__("Broadcast public keys are not all identical, aborting")
+    # reference: src/error.rs:53; party_index is an identifiable-abort
+    # extension (None on the join path, where the culprit is unknowable)
+    def __init__(self, party_index: "int | None" = None) -> None:
+        self.party_index = party_index
+        who = "" if party_index is None else f" (party {party_index})"
+        super().__init__(
+            f"Broadcast public keys are not all identical, aborting{who}"
+        )
 
 
 class DLogProofValidation(FsDkrError):

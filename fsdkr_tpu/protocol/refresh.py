@@ -31,6 +31,7 @@ from ..core import paillier, vss
 from ..core.paillier import DecryptionKey, EncryptionKey
 from ..core.secp256k1 import GENERATOR, Point, Scalar
 from ..errors import (
+    BroadcastedPublicKeyError,
     ModuliTooSmall,
     NewPartyUnassignedIndexError,
     PaillierVerificationError,
@@ -501,6 +502,13 @@ class RefreshMessage:
                     )
                     if any(l != new_n for l in lens) or len(msg.range_proofs) != new_n:
                         raise SizeMismatchError(k, *lens)
+                    # the reference gates broadcast public_key only on the
+                    # join path (add_party_message.rs:268-274, quirk 5);
+                    # here an existing party knows the true group key, so
+                    # gate every broadcast against it — an inconsistent
+                    # sender is caught by verifiers too, not just joiners
+                    if msg.public_key != key.y_sum_s:
+                        raise BroadcastedPublicKeyError(msg.party_index)
             except Exception as e:
                 errors[s] = e
                 continue

@@ -150,6 +150,26 @@ class TestAddPartyWithPermute:
 
 
 class TestWireTamper:
+    def test_inconsistent_public_key_rejected(self):
+        """A sender broadcasting a wrong group public_key must be rejected
+        by existing-party collect, not just by joiners (hardening beyond
+        reference quirk 5: add_party_message.rs:268-274 gates only the
+        join path)."""
+        from fsdkr_tpu.core.secp256k1 import GENERATOR
+        from fsdkr_tpu.errors import BroadcastedPublicKeyError
+
+        t, n = 1, 3
+        keys = simulate_keygen(t, n, CFG)
+        msgs, dks = [], []
+        for key in keys:
+            m, dk = RefreshMessage.distribute(key.i, key, n, CFG)
+            msgs.append(m)
+            dks.append(dk)
+        msgs[1].public_key = msgs[1].public_key + GENERATOR  # lie
+        with pytest.raises(BroadcastedPublicKeyError) as ei:
+            RefreshMessage.collect(msgs, keys[0], dks[0], (), CFG)
+        assert ei.value.party_index == msgs[1].party_index  # culprit named
+
     def test_tampered_ciphertext_detected(self):
         """A malicious sender mutating an encrypted share must be caught by
         the proof batch (identifiable abort)."""

@@ -4,8 +4,10 @@ SURVEY.md §2c), and LocalKey checkpoints must roundtrip."""
 
 from fsdkr_tpu.config import TEST_CONFIG
 from fsdkr_tpu.core import vss
-from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+from fsdkr_tpu.protocol import JoinMessage, RefreshMessage, simulate_keygen
 from fsdkr_tpu.protocol.serialization import (
+    join_message_from_json,
+    join_message_to_json,
     local_key_from_json,
     local_key_to_json,
     refresh_message_from_json,
@@ -38,6 +40,71 @@ def test_refresh_through_wire():
         vss.ShamirSecretSharing(t, n),
         list(range(t + 1)),
         [k.keys_linear.x_i for k in keys[: t + 1]],
+    )
+    assert old_secret.v == new_secret.v
+
+
+def test_join_message_wire_roundtrip():
+    jm, _pair = JoinMessage.distribute(CFG)
+    jm.set_party_index(2)
+    wire = join_message_to_json(jm)
+    restored = join_message_from_json(wire)
+    # canonical JSON: a second encode must be byte-identical
+    assert join_message_to_json(restored) == wire
+    assert restored.party_index == 2
+    assert restored.ek.n == jm.ek.n and restored.ek.nn == jm.ek.nn
+    assert restored.dlog_statement.N == jm.dlog_statement.N
+    assert restored.dlog_statement.g == jm.dlog_statement.g
+    assert restored.dlog_statement.ni == jm.dlog_statement.ni
+    assert restored.ring_pedersen_statement.N == jm.ring_pedersen_statement.N
+
+
+def test_permuted_replace_through_wire():
+    """Remove party 2 of a (1,4) committee, permute survivors, add one
+    fresh party at index 2 — with every refresh AND join message crossing
+    the canonical JSON wire (reference scenario src/test.rs:95-224, via
+    its serde surface)."""
+    t, n = 1, 4
+    all_keys = simulate_keygen(t, n, CFG)
+    old_secret = vss.reconstruct(
+        vss.ShamirSecretSharing(t, n),
+        [k.i - 1 for k in all_keys[: t + 1]],
+        [k.keys_linear.x_i for k in all_keys[: t + 1]],
+    )
+
+    keys = [k for k in all_keys if k.i != 2]
+    old_to_new_map = {1: 3, 3: 1, 4: 4}
+
+    jm, pair = JoinMessage.distribute(CFG)
+    jm.set_party_index(2)
+    join_wire = [join_message_to_json(jm)]
+
+    refresh_wire, dks = [], []
+    for key in keys:
+        joins = [join_message_from_json(w) for w in join_wire]
+        m, dk = RefreshMessage.replace(joins, key, old_to_new_map, n, CFG)
+        refresh_wire.append(refresh_message_to_json(m))
+        dks.append(dk)
+
+    new_keys = []
+    for key, dk in zip(keys, dks):
+        msgs = [refresh_message_from_json(w) for w in refresh_wire]
+        joins = [join_message_from_json(w) for w in join_wire]
+        RefreshMessage.collect(msgs, key, dk, joins, CFG)
+        new_keys.append((key.i, key))
+
+    msgs = [refresh_message_from_json(w) for w in refresh_wire]
+    joins = [join_message_from_json(w) for w in join_wire]
+    lk = joins[0].collect(msgs, pair, joins, t, n, CFG)
+    new_keys.append((lk.i, lk))
+
+    new_keys.sort(key=lambda e: e[0])
+    ks = [k for _, k in new_keys]
+    assert [k.i for k in ks] == [1, 2, 3, 4]
+    new_secret = vss.reconstruct(
+        vss.ShamirSecretSharing(t, n),
+        [k.i - 1 for k in ks[: t + 1]],
+        [k.keys_linear.x_i for k in ks[: t + 1]],
     )
     assert old_secret.v == new_secret.v
 
