@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Tuple
 
-from ..config import ProtocolConfig, DEFAULT_CONFIG
+from ..config import ProtocolConfig
 from ..core.paillier import EncryptionKey
 from ..core.secp256k1 import Point
 from ..core.vss import VerifiableSS
@@ -126,9 +126,14 @@ class TracedVerifier:
         return attr
 
 
-def get_backend(config: ProtocolConfig = DEFAULT_CONFIG) -> "TracedVerifier":
+def get_backend(config: ProtocolConfig) -> "TracedVerifier":
     """Returns the configured backend wrapped in a TracedVerifier (which
-    quacks like a BatchVerifier via delegation)."""
+    quacks like a BatchVerifier via delegation). config is REQUIRED: this
+    getter activates process-wide state (transcript digest) — a defaulted
+    call would silently reinstall sha256 over a non-sha256 session."""
+    from ..core.transcript import set_hash_algorithm
+
+    set_hash_algorithm(config.hash_alg)
     if config.backend == "host":
         return TracedVerifier(HostBatchVerifier())
     if config.backend == "tpu":

@@ -38,6 +38,19 @@ class ProtocolConfig:
     correct_key_rounds: int = 11
     backend: str = "host"
     mesh_shape: Optional[Tuple[int, ...]] = None
+    # Fiat-Shamir digest (reference: generic `HashChoice<H>` type param,
+    # src/refresh_message.rs:31). Any name in core.transcript._HASHES;
+    # wider digests admit m_security > 256. One hash_alg per process:
+    # entry points install it globally (core.transcript), so every call
+    # in a session — including defaulted ones, which mean DEFAULT_CONFIG
+    # and hence sha256 — must use the same config.
+    hash_alg: str = "sha256"
+    # Group (reference: generic curve `E`). The host oracle layer is
+    # generic (core.curves.make_curve); the batched device EC kernels are
+    # specialized to secp256k1, so the protocol layer currently accepts
+    # only "secp256k1" here — other curves run through core.curves
+    # directly.
+    curve: str = "secp256k1"
 
     def __post_init__(self):
         # Share recovery is only exact when the Lagrange-weighted plaintext
@@ -49,8 +62,18 @@ class ProtocolConfig:
             raise ValueError("paillier_bits must be >= 640 for exact share recovery")
         if self.paillier_bits % 2:
             raise ValueError("paillier_bits must be even")
-        if not 0 < self.m_security <= 256:
-            raise ValueError("m_security must be in (0, 256]")
+        from .core.transcript import digest_bytes
+
+        if not 0 < self.m_security <= 8 * digest_bytes(self.hash_alg):
+            raise ValueError(
+                f"m_security must be in (0, {8 * digest_bytes(self.hash_alg)}] "
+                f"for hash_alg={self.hash_alg}"
+            )
+        if self.curve != "secp256k1":
+            raise ValueError(
+                "the protocol layer is specialized to secp256k1 (device EC "
+                "kernels); use core.curves for other groups"
+            )
 
     def with_backend(self, backend: str) -> "ProtocolConfig":
         return replace(self, backend=backend)

@@ -1,0 +1,317 @@
+"""Generic short-Weierstrass curve layer (host oracle).
+
+The reference is generic over the curve `E` (`curv::elliptic::Curve`,
+`/root/reference/src/refresh_message.rs:31`); this module provides the
+equivalent capability for the rebuild: `make_curve(params)` manufactures a
+(Scalar, Point, GENERATOR) triple for any y^2 = x^3 + ax + b group, and
+`get_curve(name)` serves registered instances.
+
+secp256k1 is NOT built here — `core.secp256k1` is its specialized fast
+path (a=0 shortcuts) and the differential oracle for the batched device
+kernels (`ops.ec_batch`); `get_curve("secp256k1")` returns that module's
+classes so there is exactly one secp256k1 Point type in the process.
+Other curves (secp256r1/P-256 registered below) run host-side through the
+generic classes: the protocol layer stays specialized to secp256k1 (see
+ProtocolConfig.curve), matching how the reference's test/consumer code
+pins `E = Secp256k1`, while the primitives — VSS, Shamir, transcripts,
+ECDSA — work over any registered curve.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+from types import SimpleNamespace
+
+__all__ = ["CurveParams", "make_curve", "get_curve", "register_curve", "SECP256R1"]
+
+
+@dataclass(frozen=True)
+class CurveParams:
+    name: str
+    p: int  # field prime
+    n: int  # group order (prime)
+    a: int
+    b: int
+    gx: int
+    gy: int
+
+
+def make_curve(params: CurveParams) -> SimpleNamespace:
+    """Manufacture Scalar/Point classes bound to `params`. The API mirrors
+    core.secp256k1 exactly (Scalar arithmetic mod n, affine Point with
+    identity, compressed encoding, Jacobian scalar mul) so generic code can
+    take either."""
+    P, N, A, B = params.p, params.n, params.a, params.b
+
+    def _inv(x: int, m: int) -> int:
+        return pow(x, -1, m)
+
+    class Scalar:
+        __slots__ = ("v",)
+
+        def __init__(self, v: int):
+            object.__setattr__(self, "v", v % N)
+
+        def __setattr__(self, *_):
+            raise AttributeError("Scalar is immutable")
+
+        @staticmethod
+        def random() -> "Scalar":
+            while True:
+                v = secrets.randbelow(N)
+                if v:
+                    return Scalar(v)
+
+        @staticmethod
+        def from_int(x: int) -> "Scalar":
+            return Scalar(x % N)
+
+        @staticmethod
+        def zero() -> "Scalar":
+            return Scalar(0)
+
+        def to_int(self) -> int:
+            return self.v
+
+        def __eq__(self, other):
+            return isinstance(other, Scalar) and self.v == other.v
+
+        def __hash__(self):
+            return hash((params.name, self.v))
+
+        def __add__(self, other):
+            if not isinstance(other, Scalar):
+                return NotImplemented
+            return Scalar(self.v + other.v)
+
+        def __sub__(self, other):
+            if not isinstance(other, Scalar):
+                return NotImplemented
+            return Scalar(self.v - other.v)
+
+        def __mul__(self, other):
+            if not isinstance(other, Scalar):
+                return NotImplemented  # Scalar * Point -> Point.__rmul__
+            return Scalar(self.v * other.v)
+
+        def __neg__(self):
+            return Scalar(-self.v)
+
+        def invert(self) -> "Scalar":
+            return Scalar(_inv(self.v, N))
+
+        def __bool__(self):
+            return self.v != 0
+
+        def __repr__(self):
+            return f"Scalar<{params.name}>({hex(self.v)[:12]}...)"
+
+    class Point:
+        __slots__ = ("x", "y", "infinity")
+
+        def __init__(self, x: int | None, y: int | None):
+            if x is None:
+                self.x, self.y, self.infinity = 0, 0, True
+            else:
+                self.x, self.y, self.infinity = x, y, False
+
+        @staticmethod
+        def identity() -> "Point":
+            return Point(None, None)
+
+        @staticmethod
+        def generator() -> "Point":
+            return GENERATOR
+
+        @staticmethod
+        def from_bytes(b: bytes) -> "Point":
+            size = (P.bit_length() + 7) // 8
+            if b == b"\x00":
+                return Point.identity()
+            if len(b) == 1 + 2 * size and b[0] == 4:  # uncompressed
+                x = int.from_bytes(b[1 : 1 + size], "big")
+                y = int.from_bytes(b[1 + size :], "big")
+                if x >= P or y >= P:
+                    raise ValueError("coordinate not canonical")
+                if (y * y - (pow(x, 3, P) + A * x + B)) % P:
+                    raise ValueError("point not on curve")
+                return Point(x, y)
+            if len(b) != 1 + size or b[0] not in (2, 3):
+                raise ValueError("bad point encoding")
+            x = int.from_bytes(b[1:], "big")
+            if x >= P:
+                raise ValueError("x coordinate not canonical")
+            rhs = (pow(x, 3, P) + A * x + B) % P
+            if P % 4 != 3:  # all registered curves use p = 3 mod 4
+                raise ValueError("unsupported field for sqrt")
+            y = pow(rhs, (P + 1) // 4, P)
+            if (y * y) % P != rhs:
+                raise ValueError("point not on curve")
+            if (y & 1) != (b[0] & 1):
+                y = P - y
+            return Point(x, y)
+
+        def to_bytes(self, compressed: bool = True) -> bytes:
+            size = (P.bit_length() + 7) // 8
+            if self.infinity:
+                return b"\x00"
+            if compressed:
+                return bytes([2 | (self.y & 1)]) + self.x.to_bytes(size, "big")
+            return (
+                b"\x04"
+                + self.x.to_bytes(size, "big")
+                + self.y.to_bytes(size, "big")
+            )
+
+        def x_coord(self) -> int:
+            if self.infinity:
+                raise ValueError("identity has no coordinates")
+            return self.x
+
+        def y_coord(self) -> int:
+            if self.infinity:
+                raise ValueError("identity has no coordinates")
+            return self.y
+
+        def __eq__(self, other):
+            if not isinstance(other, Point):
+                return NotImplemented
+            if self.infinity or other.infinity:
+                return self.infinity == other.infinity
+            return self.x == other.x and self.y == other.y
+
+        def __hash__(self):
+            return hash((params.name, self.infinity, self.x, self.y))
+
+        def __add__(self, other: "Point") -> "Point":
+            if self.infinity:
+                return other
+            if other.infinity:
+                return self
+            if self.x == other.x:
+                if (self.y + other.y) % P == 0:
+                    return Point.identity()
+                return self._double()
+            lam = ((other.y - self.y) * _inv(other.x - self.x, P)) % P
+            x3 = (lam * lam - self.x - other.x) % P
+            y3 = (lam * (self.x - x3) - self.y) % P
+            return Point(x3, y3)
+
+        def _double(self) -> "Point":
+            if self.infinity or self.y == 0:
+                return Point.identity()
+            lam = ((3 * self.x * self.x + A) * _inv(2 * self.y, P)) % P
+            x3 = (lam * lam - 2 * self.x) % P
+            y3 = (lam * (self.x - x3) - self.y) % P
+            return Point(x3, y3)
+
+        def __neg__(self) -> "Point":
+            if self.infinity:
+                return self
+            return Point(self.x, (-self.y) % P)
+
+        def __sub__(self, other: "Point") -> "Point":
+            return self + (-other)
+
+        def __mul__(self, scalar) -> "Point":
+            k = scalar.v if isinstance(scalar, Scalar) else int(scalar) % N
+            if k == 0 or self.infinity:
+                return Point.identity()
+            # Jacobian double-and-add: one field inversion total
+            rx, ry, rz = 0, 1, 0
+            px, py = self.x, self.y
+            for bit in bin(k)[2:]:
+                rx, ry, rz = _jdouble(rx, ry, rz)
+                if bit == "1":
+                    rx, ry, rz = _jadd_affine(rx, ry, rz, px, py)
+            if rz == 0:
+                return Point.identity()
+            zinv = _inv(rz, P)
+            z2 = (zinv * zinv) % P
+            return Point((rx * z2) % P, (ry * z2 % P) * zinv % P)
+
+        __rmul__ = __mul__
+
+        def __repr__(self):
+            if self.infinity:
+                return f"Point<{params.name}>(identity)"
+            return f"Point<{params.name}>(x={hex(self.x)[:12]}...)"
+
+    def _jdouble(x, y, z):
+        # general-a Jacobian doubling: M = 3x^2 + a*z^4
+        if z == 0 or y == 0:
+            return 0, 1, 0
+        ysq = (y * y) % P
+        s = (4 * x * ysq) % P
+        zsq = (z * z) % P
+        m = (3 * x * x + A * zsq % P * zsq) % P
+        x3 = (m * m - 2 * s) % P
+        y3 = (m * (s - x3) - 8 * ysq * ysq) % P
+        z3 = (2 * y * z) % P
+        return x3, y3, z3
+
+    def _jadd_affine(x1, y1, z1, x2, y2):
+        # mixed Jacobian+affine addition (a-independent)
+        if z1 == 0:
+            return x2, y2, 1
+        z1z1 = (z1 * z1) % P
+        u2 = (x2 * z1z1) % P
+        s2 = (y2 * z1 * z1z1) % P
+        if x1 == u2:
+            if y1 != s2:
+                return 0, 1, 0
+            return _jdouble(x1, y1, z1)
+        h = (u2 - x1) % P
+        hh = (h * h) % P
+        i = (4 * hh) % P
+        j = (h * i) % P
+        r = (2 * (s2 - y1)) % P
+        v = (x1 * i) % P
+        x3 = (r * r - j - 2 * v) % P
+        y3 = (r * (v - x3) - 2 * y1 * j) % P
+        z3 = (2 * h * z1) % P
+        return x3, y3, z3
+
+    GENERATOR = Point(params.gx, params.gy)
+    return SimpleNamespace(
+        name=params.name,
+        params=params,
+        P=P,
+        N=N,
+        CURVE_ORDER=N,
+        Scalar=Scalar,
+        Point=Point,
+        GENERATOR=GENERATOR,
+    )
+
+
+SECP256R1 = CurveParams(
+    name="secp256r1",
+    p=0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF,
+    n=0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551,
+    a=-3,
+    b=0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B,
+    gx=0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296,
+    gy=0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5,
+)
+
+_REGISTRY: dict = {}
+
+
+def register_curve(params: CurveParams) -> None:
+    _REGISTRY[params.name] = make_curve(params)
+
+
+register_curve(SECP256R1)
+
+
+def get_curve(name: str):
+    """Registered curve namespace (P, N, Scalar, Point, GENERATOR)."""
+    if name == "secp256k1":
+        from . import secp256k1
+
+        return secp256k1
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown curve {name!r}")
+    return _REGISTRY[name]

@@ -1,9 +1,23 @@
-"""Fiat-Shamir transcript hashing (SHA-256).
+"""Fiat-Shamir transcript hashing (pluggable digest, SHA-256 default).
 
 Provides the `curv` `Digest`/`DigestExt` capability the reference uses for
 every NIZK challenge (`chain_bigint` / `result_bigint`, usage e.g.
 `/root/reference/src/range_proofs.rs:150-157`,
 `src/zk_pdl_with_slack.rs:87-95`, `src/ring_pedersen_proof.rs:96-105`).
+The reference is generic over the digest (`HashChoice<H>`,
+`src/refresh_message.rs:31`); here the equivalent knob is
+`ProtocolConfig.hash_alg`, installed process-wide by the protocol entry
+points via `set_hash_algorithm` (the same activation pattern as the
+device mesh) — every transcript and challenge-bit extraction then rides
+the configured digest. Wider digests (sha512, sha3_512, blake2b) raise
+the ring-Pedersen challenge capacity above 256 rounds.
+
+Like the mesh, the knob is one-per-process: the reference's H is a
+compile-time type parameter (one digest per build), and the equivalent
+here is one `hash_alg` per process — interleaving configs with different
+digests from multiple threads is unsupported (a proof would be hashed
+under whichever config activated last). Per-call override: the
+`algorithm=` parameter on Transcript / challenge_bits.
 
 This framework defines its own canonical encoding (SURVEY.md §7 step 2):
 each chained value is hashed as a 4-byte big-endian length prefix followed
@@ -22,14 +36,54 @@ from __future__ import annotations
 
 import hashlib
 
-__all__ = ["Transcript", "hash_ints", "challenge_bits"]
+__all__ = [
+    "Transcript",
+    "hash_ints",
+    "challenge_bits",
+    "set_hash_algorithm",
+    "get_hash_algorithm",
+    "digest_bytes",
+]
+
+# name -> (constructor, digest size in bytes); blake2b at its native 64
+_HASHES = {
+    "sha256": (hashlib.sha256, 32),
+    "sha384": (hashlib.sha384, 48),
+    "sha512": (hashlib.sha512, 64),
+    "sha3_256": (hashlib.sha3_256, 32),
+    "sha3_512": (hashlib.sha3_512, 64),
+    "blake2b": (hashlib.blake2b, 64),
+}
+
+_active = "sha256"
+
+
+def set_hash_algorithm(name: str) -> None:
+    """Install the process-wide transcript digest (ProtocolConfig.hash_alg)."""
+    if name not in _HASHES:
+        raise ValueError(f"unknown hash_alg {name!r}; choose from {sorted(_HASHES)}")
+    global _active
+    _active = name
+
+
+def get_hash_algorithm() -> str:
+    return _active
+
+
+def digest_bytes(algorithm: str | None = None) -> int:
+    name = algorithm or _active
+    if name not in _HASHES:
+        raise ValueError(f"unknown hash_alg {name!r}; choose from {sorted(_HASHES)}")
+    return _HASHES[name][1]
 
 
 class Transcript:
-    """SHA-256 transcript over a sequence of non-negative integers / bytes."""
+    """Transcript over a sequence of non-negative integers / bytes, using
+    the active digest (default SHA-256)."""
 
-    def __init__(self, domain: bytes = b""):
-        self._h = hashlib.sha256()
+    def __init__(self, domain: bytes = b"", algorithm: str | None = None):
+        digest_bytes(algorithm)  # uniform ValueError on unknown names
+        self._h = _HASHES[algorithm or _active][0]()
         if domain:
             self.chain_bytes(domain)
 
@@ -51,6 +105,16 @@ class Transcript:
     def result_int(self) -> int:
         return int.from_bytes(self._h.digest(), "big")
 
+    def result_challenge(self, bits: int = 256) -> int:
+        """Digest truncated to a fixed challenge width. The integer-
+        challenge sigma protocols (range, PDL, composite-dlog) size their
+        blinding/range gates for a 256-bit challenge (q^3 slack,
+        STAT_BITS); a wider configured digest must not widen e, or
+        honest s1 = e*a + alpha overflows the verifier's range gate and
+        integer responses lose statistical hiding. For sha256 this is
+        the identity, preserving reference-exact challenges."""
+        return self.result_int() & ((1 << bits) - 1)
+
     def result_bytes(self) -> bytes:
         return self._h.digest()
 
@@ -62,11 +126,15 @@ def hash_ints(values, domain: bytes = b"") -> int:
     return t.result_int()
 
 
-def challenge_bits(e: int, m: int) -> list[int]:
+def challenge_bits(e: int, m: int, algorithm: str | None = None) -> list[int]:
     """Extract m binary challenges from challenge integer e, Lsb0 order over
-    the 32-byte big-endian digest representation
+    the big-endian digest representation of the active hash
     (reference: `src/ring_pedersen_proof.rs:106`)."""
-    if m > 256:
-        raise ValueError("SHA-256 transcripts yield at most 256 challenge bits")
-    raw = e.to_bytes(32, "big")
+    size = digest_bytes(algorithm)
+    if m > 8 * size:
+        raise ValueError(
+            f"{algorithm or _active} transcripts yield at most {8 * size} "
+            "challenge bits"
+        )
+    raw = e.to_bytes(size, "big")
     return [(raw[i >> 3] >> (i & 7)) & 1 for i in range(m)]

@@ -45,7 +45,7 @@ def _challenge(n: int, c: int, z: int, u: int, w: int) -> int:
         .chain_int(z)
         .chain_int(u)
         .chain_int(w)
-        .result_int()
+        .result_challenge()
     )
 
 

@@ -59,7 +59,7 @@ def _challenge(
         X, u = check
         tr.chain_int(X.x_coord()).chain_int(X.y_coord())
         tr.chain_int(u.x_coord()).chain_int(u.y_coord())
-    return tr.result_int()
+    return tr.result_challenge()
 
 
 @dataclass(frozen=True)

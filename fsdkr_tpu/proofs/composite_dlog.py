@@ -54,7 +54,7 @@ class CompositeDLogProof:
             .chain_int(st.g)
             .chain_int(st.N)
             .chain_int(st.ni)
-            .result_int()
+            .result_challenge()
         )
 
     @staticmethod

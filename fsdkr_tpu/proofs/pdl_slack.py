@@ -87,7 +87,7 @@ class PDLwSlackProof:
             .chain_point(u1)
             .chain_int(u2)
             .chain_int(u3)
-            .result_int()
+            .result_challenge()
         )
 
     @staticmethod

@@ -47,6 +47,9 @@ def generate_dlog_statement_proofs(
 ) -> tuple[DLogStatement, CompositeDLogProof, CompositeDLogProof]:
     """DLogStatement + composite-dlog proofs in both base directions
     (reference `/root/reference/src/add_party_message.rs:69-92`)."""
+    from ..core.transcript import set_hash_algorithm
+
+    set_hash_algorithm(config.hash_alg)
     n_tilde, h1, h2, xhi, xhi_inv = generate_h1_h2_n_tilde(config)
     st_h1 = DLogStatement(N=n_tilde, g=h1, ni=h2)
     st_h2 = DLogStatement(N=n_tilde, g=h2, ni=h1)

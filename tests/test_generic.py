@@ -1,0 +1,134 @@
+"""Curve/hash genericity (reference: generic `E` + `HashChoice<H>`,
+src/refresh_message.rs:31): the transcript digest is a runtime config knob
+threaded through every proof, and the curve core is a factory with
+registered instances beyond secp256k1."""
+
+import pytest
+
+from fsdkr_tpu.config import ProtocolConfig
+from fsdkr_tpu.core.transcript import (
+    Transcript,
+    challenge_bits,
+    digest_bytes,
+    get_hash_algorithm,
+    set_hash_algorithm,
+)
+
+
+@pytest.fixture(autouse=True)
+def _restore_hash():
+    prev = get_hash_algorithm()
+    yield
+    set_hash_algorithm(prev)
+
+
+class TestHashChoice:
+    def test_digest_sizes_and_bit_capacity(self):
+        assert digest_bytes("sha256") == 32
+        assert digest_bytes("sha3_512") == 64
+        with pytest.raises(ValueError):
+            challenge_bits(1, 257, "sha256")
+        assert len(challenge_bits(1, 300, "sha3_512")) == 300
+
+    def test_transcripts_differ_by_algorithm(self):
+        a = Transcript(b"d", algorithm="sha256").chain_int(7).result_int()
+        b = Transcript(b"d", algorithm="sha3_256").chain_int(7).result_int()
+        assert a != b
+
+    def test_config_gates_m_security_by_digest(self):
+        with pytest.raises(ValueError):
+            ProtocolConfig(paillier_bits=768, m_security=300)  # sha256 cap
+        cfg = ProtocolConfig(
+            paillier_bits=768, m_security=300, hash_alg="sha512"
+        )
+        assert cfg.hash_alg == "sha512"
+        with pytest.raises(ValueError):
+            ProtocolConfig(paillier_bits=768, hash_alg="md5")
+
+    def test_refresh_end_to_end_under_sha3_512(self):
+        """Full refresh with every Fiat-Shamir transcript on sha3-512 —
+        prover and verifier agree through the config knob alone."""
+        from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+
+        cfg = ProtocolConfig(
+            paillier_bits=768,
+            m_security=32,
+            correct_key_rounds=3,
+            hash_alg="sha3_512",
+        )
+        keys = simulate_keygen(1, 3, cfg)
+        msgs, dks = [], []
+        for k in keys:
+            m, dk = RefreshMessage.distribute(k.i, k, 3, cfg)
+            msgs.append(m)
+            dks.append(dk)
+        RefreshMessage.collect(msgs, keys[0], dks[0], (), cfg)
+        assert get_hash_algorithm() == "sha3_512"
+
+    def test_cross_hash_verification_fails(self):
+        """A proof generated under one digest must not verify under
+        another (domain separation of the knob)."""
+        from fsdkr_tpu.proofs.composite_dlog import (
+            CompositeDLogProof,
+            DLogStatement,
+        )
+        from fsdkr_tpu.protocol.keygen import generate_h1_h2_n_tilde
+
+        cfg = ProtocolConfig(paillier_bits=768, m_security=32)
+        n_tilde, h1, h2, xhi, _ = generate_h1_h2_n_tilde(cfg)
+        st = DLogStatement(N=n_tilde, g=h1, ni=h2)
+        set_hash_algorithm("sha256")
+        proof = CompositeDLogProof.prove(st, xhi)
+        assert proof.verify(st)
+        set_hash_algorithm("sha3_256")
+        assert not proof.verify(st)
+
+
+class TestGenericCurve:
+    def test_secp256r1_group_law(self):
+        from fsdkr_tpu.core.curves import get_curve
+
+        c = get_curve("secp256r1")
+        G = c.GENERATOR
+        # generator satisfies the curve equation
+        assert (G.y * G.y - (G.x**3 + c.params.a * G.x + c.params.b)) % c.P == 0
+        # group order: n*G = identity, (n+1)*G = G
+        assert (G * c.N).infinity
+        assert G * (c.N + 1) == G
+        # distributivity and add/double consistency
+        k1, k2 = c.Scalar.from_int(123456789), c.Scalar.from_int(987654321)
+        assert G * (k1 + k2) == G * k1 + G * k2
+        assert G + G == G * 2
+
+    def test_secp256r1_encoding_roundtrip(self):
+        from fsdkr_tpu.core.curves import get_curve
+
+        c = get_curve("secp256r1")
+        p = c.GENERATOR * c.Scalar.from_int(0xDEADBEEF)
+        assert c.Point.from_bytes(p.to_bytes(compressed=True)) == p
+        assert c.Point.from_bytes(p.to_bytes(compressed=False)) == p
+        with pytest.raises(ValueError):
+            c.Point.from_bytes(b"\x02" + b"\xff" * 32)  # x >= P
+
+    def test_secp256r1_jacobian_matches_additions(self):
+        from fsdkr_tpu.core.curves import get_curve
+
+        c = get_curve("secp256r1")
+        G = c.GENERATOR
+        acc = c.Point.identity()
+        for k in range(1, 9):
+            acc = acc + G
+            assert G * k == acc
+
+    def test_secp256k1_served_by_registry(self):
+        from fsdkr_tpu.core import secp256k1
+        from fsdkr_tpu.core.curves import get_curve
+
+        c = get_curve("secp256k1")
+        assert c.Point is secp256k1.Point  # one Point type in the process
+        with pytest.raises(ValueError):
+            get_curve("curve25519")
+
+    def test_protocol_layer_pins_secp256k1(self):
+        with pytest.raises(ValueError):
+            ProtocolConfig(paillier_bits=768, curve="secp256r1")
