@@ -126,6 +126,35 @@ def init_jax_with_retry(attempts=4, delay=15.0):
     )
 
 
+def roofline_fields(t_warm, stats=None):
+    """mfu/gmacs fields for a bench JSON, from tracer stats accumulated
+    during the warm run (caller resets the tracer before it), or from an
+    explicit stats dict. Empty when FSDKR_TRACE is off or no device
+    modexp launched."""
+    from fsdkr_tpu.utils.roofline import peak_macs
+    from fsdkr_tpu.utils.trace import get_tracer
+
+    tr = get_tracer()
+    if not tr.enabled:
+        return {}
+    peak = peak_macs()
+    if stats is None:
+        stats = tr.stats()
+    mfu = {
+        name: {"gmacs": round(st.macs / 1e9, 2), "mfu": float(f"{st.mfu(peak):.3g}")}
+        for name, st in stats.items()
+        if st.macs > 0
+    }
+    if not mfu:
+        return {}
+    total = sum(st.macs for st in stats.values())
+    return {
+        "mfu": mfu,
+        "mfu_collect": float(f"{total / (t_warm * peak):.3g}"),
+        "peak_macs": peak,
+    }
+
+
 def bench_sessions(sessions_count, n, t, bits, m_sec):
     """Config-5 shape: S independent (n, t) sessions, one fused collect
     launch set (RefreshMessage.collect_sessions)."""
@@ -171,6 +200,9 @@ def bench_sessions(sessions_count, n, t, bits, m_sec):
 
     t_cold = run()
     log(f"fused collect_sessions cold: {t_cold:.2f}s")
+    from fsdkr_tpu.utils.trace import get_tracer
+
+    get_tracer().reset()
     t_warm = run()
     total_proofs = proofs_per_session * sessions_count
     log(
@@ -190,6 +222,7 @@ def bench_sessions(sessions_count, n, t, bits, m_sec):
             "collect_cold_s": round(t_cold, 2),
             "sessions": sessions_count,
             "mesh": mesh_shape,
+            **roofline_fields(t_warm),
         }
     )
 
@@ -241,6 +274,9 @@ def bench_join(n, t, bits, m_sec, joins):
     RefreshMessage.collect(msgs, keys[0].clone(), dks[0], join_messages, tpu_cfg)
     t_cold = time.time() - t0
     log(f"join collect cold: {t_cold:.2f}s")
+    from fsdkr_tpu.utils.trace import get_tracer
+
+    get_tracer().reset()
     t0 = time.time()
     RefreshMessage.collect(msgs, keys[1].clone(), dks[1], join_messages, tpu_cfg)
     t_warm = time.time() - t0
@@ -257,6 +293,7 @@ def bench_join(n, t, bits, m_sec, joins):
             "collect_warm_s": round(t_warm, 2),
             "collect_cold_s": round(t_cold, 2),
             "replace_s": round(t_replace, 2),
+            **roofline_fields(t_warm),
         }
     )
 
@@ -301,11 +338,16 @@ def main():
     from fsdkr_tpu.utils.trace import get_tracer
 
     # prover-side phase split (includes first-launch compiles)
+    dist_stats = get_tracer().stats()
     trace_distribute = {
         name: round(st.seconds, 3)
-        for name, st in get_tracer().stats().items()
+        for name, st in dist_stats.items()
         if name.startswith("distribute.")
     } or None
+    mfu_distribute = roofline_fields(
+        t_distribute,
+        {k: v for k, v in dist_stats.items() if k.startswith("distribute.")},
+    ).get("mfu")
 
     # proof instances verified by one collect (excluding n^2 Feldman EC
     # checks and 2 joins' dlog proofs, which are zero here)
@@ -324,12 +366,14 @@ def main():
     t_tpu = time.time() - t0
     log(f"tpu collect warm: {t_tpu:.2f}s -> {proofs / t_tpu:.1f} proofs/s")
     trace_out = None
+    rf = {}
     if get_tracer().enabled:  # FSDKR_TRACE=1: per-family breakdown
         log(get_tracer().report())
+        stats = get_tracer().stats()
         trace_out = {
-            name: round(st.seconds, 3)
-            for name, st in get_tracer().stats().items()
+            name: round(st.seconds, 3) for name, st in stats.items()
         }
+        rf = roofline_fields(t_tpu, stats)
 
     # --- host baseline on a subsample (serial loop; linear extrapolation)
     # Two baselines: the native C++ Montgomery path (intops.mod_pow routes
@@ -427,6 +471,9 @@ def main():
         result["trace"] = trace_out  # warm-collect per-phase seconds
     if trace_distribute:
         result["trace_distribute"] = trace_distribute
+    result.update(rf)  # per-phase {gmacs, mfu} + mfu_collect + peak_macs
+    if mfu_distribute:
+        result["mfu_distribute"] = mfu_distribute
     emit(result)
 
 

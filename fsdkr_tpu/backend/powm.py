@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from typing import Callable, List, Sequence
 
-from ..config import ProtocolConfig, DEFAULT_CONFIG
+from ..config import ProtocolConfig
 
 BatchPowm = Callable[[Sequence[int], Sequence[int], Sequence[int]], List[int]]
 
@@ -96,6 +96,8 @@ def tpu_modmul(a, b, moduli) -> List[int]:
     if not a:
         return []
     from ..ops.limbs import limbs_for_bits
+    from ..utils.roofline import modmul_macs
+    from ..utils.trace import get_tracer
 
     rows = len(a)
     pad = _pad_pow2(rows) - rows
@@ -103,6 +105,7 @@ def tpu_modmul(a, b, moduli) -> List[int]:
     b = list(b) + [1] * pad
     moduli = list(moduli) + [3] * pad
     k = limbs_for_bits(max(m.bit_length() for m in moduli))
+    get_tracer().add_macs(modmul_macs(len(a), k))
     return _cached_ctx(moduli, k).modmul(a, b)[:rows]
 
 
@@ -136,7 +139,9 @@ def tpu_powm(bases, exps, moduli) -> List[int]:
             hi = lo + _MAX_ROWS
             out += tpu_powm(bases[lo:hi], exps[lo:hi], moduli[lo:hi])
         return out
-    from ..ops.limbs import limbs_for_bits
+    from ..ops.limbs import bucket_exp_bits, limbs_for_bits
+    from ..utils.roofline import generic_modexp_macs
+    from ..utils.trace import get_tracer
 
     b = len(bases)
     pad = _pad_pow2(b) - b
@@ -145,14 +150,19 @@ def tpu_powm(bases, exps, moduli) -> List[int]:
     moduli = list(moduli) + [3] * pad
 
     width = max(m.bit_length() for m in moduli)
+    e_bits = bucket_exp_bits(exps)
     if b >= _RNS_MIN_ROWS:
         for cls in _RNS_WIDTH_CLASSES:
             if width <= cls:
                 from ..ops.rns import rns_modexp
 
+                get_tracer().add_macs(
+                    generic_modexp_macs(len(bases), e_bits, cls // 16)
+                )
                 return rns_modexp(bases, exps, moduli, cls, mesh=_MESH)[:b]
 
     k = limbs_for_bits(width)
+    get_tracer().add_macs(generic_modexp_macs(len(bases), e_bits, k))
     return _cached_ctx(moduli, k).modexp(bases, exps)[:b]
 
 
@@ -224,16 +234,23 @@ def tpu_powm_shared(bases, exps_per_group, moduli) -> List[List[int]]:
     exps = [list(e) + [0] * (m_pad - len(e)) for e in exps_per_group]
     exps += [[0] * m_pad] * (g_pad - g)
 
+    from ..utils.roofline import shared_modexp_macs
+    from ..utils.trace import get_tracer
+
     width = max(m.bit_length() for m in moduli)
     if g_pad * m_pad >= _RNS_MIN_ROWS:
         for cls in _RNS_WIDTH_CLASSES:
             if width <= cls:
                 from ..ops.rns import rns_modexp_shared
 
+                get_tracer().add_macs(
+                    shared_modexp_macs(g_pad, m_pad, w_cnt, cls // 16)
+                )
                 out = rns_modexp_shared(bases, exps, moduli, cls, mesh=_MESH)
                 return [out[i][: len(exps_per_group[i])] for i in range(g)]
 
     k = limbs_for_bits(width)
+    get_tracer().add_macs(shared_modexp_macs(g_pad, m_pad, w_cnt, k))
     out = shared_base_modexp(
         bases, exps, moduli, k, ctx=_cached_ctx(moduli, k).ctx, mesh=_MESH
     )
@@ -281,7 +298,13 @@ def tpu_powm_grouped(bases, exps, moduli) -> List[int]:
     return out
 
 
-def get_batch_powm(config: ProtocolConfig = DEFAULT_CONFIG) -> BatchPowm:
+def get_batch_powm(config: ProtocolConfig) -> BatchPowm:
+    # config is REQUIRED: this getter activates process-wide state (mesh,
+    # transcript digest) — a defaulted call would silently reinstall
+    # sha256 over an active non-sha256 session
+    from ..core.transcript import set_hash_algorithm
+
+    set_hash_algorithm(config.hash_alg)
     apply_mesh(config)
     return tpu_powm_grouped if config.backend == "tpu" else host_powm
 

@@ -1,0 +1,78 @@
+"""Roofline / MFU accounting for the modexp kernel families.
+
+proofs/s alone cannot distinguish "fast" from "busy": a collect() that
+spends its time in host orchestration and a collect() that saturates the
+MXU can post the same throughput at small n. Each device launch therefore
+reports an *analytic* MAC count (u16 x u16 partial products — the native
+word of both kernel families: CIOS multiplies 16-bit limbs on the VPU,
+the RNS path rides 16-bit-channel matmuls on the MXU) to the tracer,
+which divides by wall-clock and the chip's peak to give a model-flops
+utilization per phase.
+
+Peak normalization: TPU v5e ~197 TFLOP/s bf16 = 98.5e12 MAC/s. A u16
+product is work-equivalent to a bf16 MAC on the MXU (one systolic cell
+pass), so `mfu = macs / seconds / V5E_PEAK_MACS`. The number is an
+engineering roofline (analytic op counts, padded rows included — padding
+is real device work), not a profiler measurement; use
+`utils.trace.jax_profile` for ground truth.
+
+The formulas intentionally count only multiply work (the >95% term);
+additions, selects and layout ops ride along. Reference workload being
+priced: the collect() verify loop, `/root/reference/src/refresh_message.rs:321-467`.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = [
+    "V5E_PEAK_MACS",
+    "peak_macs",
+    "montmul_macs",
+    "generic_modexp_macs",
+    "shared_modexp_macs",
+    "modmul_macs",
+]
+
+# v5e bf16 peak, in MACs/s (197 TFLOP/s / 2 FLOPs-per-MAC). Override for
+# other parts (v4: 137.5e12, v5p: 229.5e12) via FSDKR_PEAK_MACS.
+V5E_PEAK_MACS = 98.5e12
+
+
+def peak_macs() -> float:
+    return float(os.environ.get("FSDKR_PEAK_MACS", V5E_PEAK_MACS))
+
+
+def montmul_macs(k: int) -> float:
+    """u16 MACs per k-limb Montgomery multiply.
+
+    CIOS: the product scan and the reduction scan each run k x (k+1)
+    limb multiplies -> ~2k^2. The RNS equivalent (one MontMul = two
+    base-extension matmuls of shape (rows, k) @ (k, k+1) plus O(k)
+    channel ops) prices the same to leading order, so one formula serves
+    both routers.
+    """
+    return 2.0 * k * k
+
+
+def generic_modexp_macs(rows: int, exp_bits: int, k: int) -> float:
+    """Generic windowed (4-bit) kernel: per row, exp_bits squarings +
+    exp_bits/4 table muls + ~17 fixed muls (15 table entries, domain
+    enter/exit)."""
+    montmuls = rows * (exp_bits + exp_bits // 4 + 17)
+    return montmuls * montmul_macs(k)
+
+
+def shared_modexp_macs(
+    groups: int, rows_per_group: int, windows: int, k: int
+) -> float:
+    """Fixed-base comb: accumulation is `windows` MontMuls per row; the
+    fly-built 16-entry tables are ~15 products per (window, group); the
+    device power ladder is 4 squarings per (window, group)."""
+    montmuls = windows * (groups * rows_per_group + 19 * groups)
+    return montmuls * montmul_macs(k)
+
+
+def modmul_macs(rows: int, k: int) -> float:
+    """One MontMul per row plus domain enter/exit (~3 total)."""
+    return rows * 3 * montmul_macs(k)

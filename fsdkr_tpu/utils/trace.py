@@ -37,10 +37,14 @@ class PhaseStats:
     calls: int = 0
     seconds: float = 0.0
     items: int = 0
+    macs: float = 0.0  # analytic u16-MAC count (utils.roofline)
 
     @property
     def items_per_second(self) -> float:
         return self.items / self.seconds if self.seconds > 0 else 0.0
+
+    def mfu(self, peak: float) -> float:
+        return self.macs / self.seconds / peak if self.seconds > 0 else 0.0
 
 
 @dataclass
@@ -50,6 +54,7 @@ class Tracer:
     )
     _stats: Dict[str, PhaseStats] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock)
+    _local: threading.local = field(default_factory=threading.local)
 
     def enable(self) -> None:
         self.enabled = True
@@ -66,16 +71,36 @@ class Tracer:
         if not self.enabled:
             yield
             return
+        stack = self._phase_stack()
+        stack.append(name)
         t0 = time.perf_counter()
         try:
             yield
         finally:
             dt = time.perf_counter() - t0
+            stack.pop()
             with self._lock:
                 st = self._stats.setdefault(name, PhaseStats())
                 st.calls += 1
                 st.seconds += dt
                 st.items += items
+
+    def _phase_stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def add_macs(self, macs: float) -> None:
+        """Attribute analytic device work (utils.roofline formulas) to the
+        innermost active phase of this thread — the kernel launch layer
+        calls this without knowing which protocol phase it serves."""
+        if not self.enabled:
+            return
+        stack = self._phase_stack()
+        name = stack[-1] if stack else "(unphased)"
+        with self._lock:
+            self._stats.setdefault(name, PhaseStats()).macs += macs
 
     def count(self, name: str, items: int = 1) -> None:
         if not self.enabled:
@@ -88,22 +113,27 @@ class Tracer:
     def stats(self) -> Dict[str, PhaseStats]:
         with self._lock:
             return {
-                k: PhaseStats(v.calls, v.seconds, v.items)
+                k: PhaseStats(v.calls, v.seconds, v.items, v.macs)
                 for k, v in self._stats.items()
             }
 
     def report(self) -> str:
+        from .roofline import peak_macs
+
+        peak = peak_macs()
         rows = sorted(self.stats().items(), key=lambda kv: -kv[1].seconds)
         if not rows:
             return "(no phases recorded)"
         width = max(len(k) for k, _ in rows)
         lines = [
-            f"{'phase':{width}s} {'calls':>6s} {'seconds':>9s} {'items':>8s} {'items/s':>10s}"
+            f"{'phase':{width}s} {'calls':>6s} {'seconds':>9s} {'items':>8s} "
+            f"{'items/s':>10s} {'GMACs':>9s} {'mfu%':>7s}"
         ]
         for name, st in rows:
             lines.append(
                 f"{name:{width}s} {st.calls:6d} {st.seconds:9.3f} "
-                f"{st.items:8d} {st.items_per_second:10.1f}"
+                f"{st.items:8d} {st.items_per_second:10.1f} "
+                f"{st.macs / 1e9:9.2f} {100 * st.mfu(peak):7.3f}"
             )
         return "\n".join(lines)
 

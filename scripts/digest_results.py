@@ -39,11 +39,15 @@ def main():
             elif "metric" in rec:
                 configs.append((name, rec))
                 if rec.get("trace"):
-                    traces[f"{name} (warm collect)"] = rec["trace"]
+                    traces[f"{name} (warm collect)"] = (
+                        rec["trace"],
+                        rec.get("mfu") or {},
+                    )
                 if rec.get("trace_distribute"):
-                    traces[f"{name} (distribute, incl. compiles)"] = rec[
-                        "trace_distribute"
-                    ]
+                    traces[f"{name} (distribute, incl. compiles)"] = (
+                        rec["trace_distribute"],
+                        rec.get("mfu_distribute") or {},
+                    )
 
     if configs:
         print("### collect() configurations\n")
@@ -59,12 +63,16 @@ def main():
                 print(f"|  | ERROR: {r['error'][:90]} | | | | | |")
         print()
 
-    for name, tr in traces.items():
-        print(f"### per-phase breakdown: {name}, seconds\n")
-        print("| phase | seconds |")
-        print("|---|---|")
+    for name, (tr, mfu) in traces.items():
+        print(f"### per-phase breakdown: {name}\n")
+        print("| phase | seconds | GMACs | mfu |")
+        print("|---|---|---|---|")
         for phase, secs in sorted(tr.items(), key=lambda kv: -kv[1]):
-            print(f"| {phase} | {secs} |")
+            m = mfu.get(phase, {})
+            print(
+                f"| {phase} | {secs} | {m.get('gmacs', '—')} "
+                f"| {m.get('mfu', '—')} |"
+            )
         print()
 
     if kernels:

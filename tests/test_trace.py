@@ -59,3 +59,35 @@ class TestProtocolIntegration:
         ):
             assert expected in stats, (expected, sorted(stats))
             assert stats[expected].items > 0
+
+
+def test_mac_attribution_to_innermost_phase():
+    """add_macs lands on the innermost active phase (the launch layer
+    calls it without knowing its protocol phase), and mfu derives from
+    the same phase's wall-clock."""
+    from fsdkr_tpu.utils.trace import Tracer
+
+    tr = Tracer(enabled=True)
+    with tr.phase("outer"):
+        with tr.phase("outer.inner"):
+            tr.add_macs(1e9)
+    tr.add_macs(5.0)  # outside any phase
+    stats = tr.stats()
+    assert stats["outer.inner"].macs == 1e9
+    assert stats["outer"].macs == 0
+    assert stats["(unphased)"].macs == 5.0
+    assert stats["outer.inner"].mfu(1e12) > 0
+    assert "mfu%" in tr.report()
+
+
+def test_roofline_formulas_scale():
+    from fsdkr_tpu.utils import roofline as rl
+
+    # 2048-bit modulus = 128 limbs; full-width exponent
+    per_row = rl.generic_modexp_macs(1, 2048, 128)
+    assert 5e7 < per_row < 1.2e8  # ~2577 MontMuls x ~32.8k MACs
+    # comb amortizes: per-row cost at large m is ~W MontMuls
+    g, m, w = 16, 1024, 512
+    per_row_comb = rl.shared_modexp_macs(g, m, w, 128) / (g * m)
+    assert per_row_comb < per_row / 3
+    assert rl.peak_macs() > 1e13
