@@ -1,0 +1,30 @@
+#!/bin/bash
+# Per-commit gate, mirroring the reference's pipeline
+# (/root/reference/.github/workflows/pull_request.yml: check, test, fmt,
+# clippy) with the tools this image has:
+#   check  -> byte-compile every source tree + package import
+#   test   -> the smoke tier: quick suite minus `heavy` kernel
+#             differentials (pytest.ini already excludes `slow`);
+#             session-scoped keygen caching makes this the <3 min gate
+#   lint   -> compileall is the only static gate available (no
+#             pyflakes/ruff/black in the image; documented substitute)
+# Full suite on demand: pytest tests/ -m "not slow" (quick) or
+# pytest tests/ -m "" (everything, ~hours on this box).
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== check: byte-compile =="
+python -m compileall -q fsdkr_tpu tests scripts bench.py __graft_entry__.py
+
+echo "== check: package import =="
+python - <<'EOF'
+import fsdkr_tpu
+from fsdkr_tpu.protocol import RefreshMessage, JoinMessage  # API surface
+from fsdkr_tpu import config, errors
+print("import ok:", fsdkr_tpu.__name__)
+EOF
+
+echo "== test: smoke tier =="
+python -m pytest tests/ -q -m "not slow and not heavy" -p no:cacheprovider
+
+echo "== ci.sh: all gates green =="

@@ -26,10 +26,66 @@ import pytest  # noqa: E402
 
 from fsdkr_tpu.config import TEST_CONFIG  # noqa: E402
 
+# ---------------------------------------------------------------------------
+# Session-scoped keygen cache. simulate_keygen dominates suite wall-clock on
+# this single-core box (every call generates n Paillier pairs + n ring-
+# Pedersen moduli at 768 bits); most tests just need *a* valid committee.
+# Cache the first result per (t, n, config) and hand out deepcopies — tests
+# mutate LocalKeys (refresh rotates shares in place, collect zeroizes dks),
+# so each test gets a private copy of an identical committee. Disable with
+# FSDKR_TEST_KEYGEN_CACHE=0 for tests that need fresh randomness.
+# ---------------------------------------------------------------------------
+if os.environ.get("FSDKR_TEST_KEYGEN_CACHE", "1").lower() not in (
+    "",
+    "0",
+    "false",
+    "off",
+    "no",
+):
+    import copy  # noqa: E402
+
+    from fsdkr_tpu import protocol as _protocol  # noqa: E402
+    from fsdkr_tpu.protocol import keygen as _keygen_mod  # noqa: E402
+
+    _real_simulate_keygen = _keygen_mod.simulate_keygen
+    _keygen_cache: dict = {}
+
+    def _cached_simulate_keygen(t, n, *args, **kwargs):
+        # pass config through untouched so the wrapped function's own
+        # default (DEFAULT_CONFIG) applies identically with cache on/off
+        config = args[0] if args else kwargs.get("config")
+        key = (t, n, repr(config))  # content key: configs are dataclasses
+        if key not in _keygen_cache:
+            _keygen_cache[key] = _real_simulate_keygen(t, n, *args, **kwargs)
+        else:
+            # replicate the real keygen's process-wide side effect on
+            # cache hits, or global digest state would depend on cache
+            from fsdkr_tpu.config import DEFAULT_CONFIG
+            from fsdkr_tpu.core.transcript import set_hash_algorithm
+
+            set_hash_algorithm((config or DEFAULT_CONFIG).hash_alg)
+        return copy.deepcopy(_keygen_cache[key])
+
+    # tests that NEED independent committees (e.g. cross-session row
+    # attribution in fused collects) call simulate_keygen.uncached
+    _cached_simulate_keygen.uncached = _real_simulate_keygen
+    _keygen_mod.simulate_keygen = _cached_simulate_keygen
+    _protocol.simulate_keygen = _cached_simulate_keygen
+    # simulation.py binds the name at import time as well
+    from fsdkr_tpu.protocol import simulation as _simulation  # noqa: E402
+
+    if hasattr(_simulation, "simulate_keygen"):
+        _simulation.simulate_keygen = _cached_simulate_keygen
+
 
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: full-size security parameters; excluded from quick runs"
+    )
+    config.addinivalue_line(
+        "markers",
+        "heavy: minutes-long kernel differentials / mesh compiles; excluded "
+        "from the smoke gate (scripts/ci.sh) but part of the quick suite",
     )
 
 

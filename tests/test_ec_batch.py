@@ -22,6 +22,7 @@ def _rand_point():
     return GENERATOR * Scalar.random()
 
 
+@pytest.mark.heavy
 class TestScalarMul:
     def test_edge_scalars(self):
         pts = [GENERATOR, _rand_point(), Point.identity(), _rand_point(), GENERATOR]
@@ -46,6 +47,7 @@ class TestScalarMul:
         assert got.infinity
 
 
+@pytest.mark.heavy
 class TestMSM:
     def test_ragged_groups(self):
         groups_p = [
@@ -62,6 +64,7 @@ class TestMSM:
             batch_msm([[GENERATOR]], [[1, 2]])
 
 
+@pytest.mark.heavy
 class TestFeldmanRLC:
     def _items(self, t, n):
         secret = Scalar.random()
@@ -96,6 +99,7 @@ class TestFeldmanRLC:
         assert verdicts == [True] * 3 + [False, True, True, True]
 
 
+@pytest.mark.heavy
 class TestPdlU1RLC:
     def test_corrupted_u1_attributed(self, test_config):
         from fsdkr_tpu.backend.tpu_verifier import TpuBatchVerifier

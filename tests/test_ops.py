@@ -99,6 +99,7 @@ class TestBatchModExp:
                 pow(b, e, n) for b, e, n in zip(bases, exps, moduli)
             ]
 
+@pytest.mark.heavy
 class TestSharedBaseModExp:
     """The fixed-base comb kernel: groups share (base, modulus), exactly
     the shape of the ring-Pedersen and PDL/range verification columns."""
@@ -214,6 +215,7 @@ class TestBatchModInv:
             assert got == pow(v, -1, m2)
 
 
+@pytest.mark.heavy
 def test_comb_tree_matches_ladder():
     """Chunked tree accumulation (tree_chunk > 1) must agree with the
     sequential ladder (tree_chunk=1) and the host oracle, including a

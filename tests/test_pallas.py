@@ -54,6 +54,7 @@ def _to_residues(xs, rb):
     )
 
 
+@pytest.mark.heavy
 class TestPallasMontMul:
     def test_matches_xla_chain(self, bases_512):
         """Same inputs through the Pallas kernel (interpret) and the XLA

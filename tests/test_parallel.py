@@ -60,6 +60,7 @@ def test_mesh_validation():
         make_mesh((2, 4), ("batch",))
 
 
+@pytest.mark.heavy
 class TestMeshedProtocol:
     """config.mesh_shape consumed end-to-end: the production collect()
     path with every kernel launch row-sharded over the 8-device mesh."""
@@ -99,13 +100,17 @@ class TestMeshedProtocol:
 
         t, n = 1, 3
         cfg = test_config
+        # independent committees matter here: identical moduli across
+        # sessions would mask cross-session row-attribution bugs, so
+        # bypass the conftest keygen cache for the second session
+        fresh_keygen = getattr(simulate_keygen, "uncached", simulate_keygen)
 
         # session 0: plain refresh
         keys0 = simulate_keygen(t, n, cfg)
         res0 = RefreshMessage.distribute_batch([(k.i, k) for k in keys0], n, cfg)
 
         # session 1: 2 existing parties + 1 join at index 3
-        keys1 = simulate_keygen(t, n, cfg)
+        keys1 = fresh_keygen(t, n, cfg)
         keys1 = [k for k in keys1 if k.i != 3]
         jm, _pair = JoinMessage.distribute(cfg)
         jm.set_party_index(3)
@@ -132,9 +137,11 @@ class TestMeshedProtocol:
 
         t, n = 1, 3
         cfg = test_config
+        # distinct committees per session (see test_collect_sessions_with_joins)
+        fresh_keygen = getattr(simulate_keygen, "uncached", simulate_keygen)
         sessions = []
-        for _ in range(2):
-            keys = simulate_keygen(t, n, cfg)
+        for i in range(2):
+            keys = (simulate_keygen if i == 0 else fresh_keygen)(t, n, cfg)
             results = RefreshMessage.distribute_batch(
                 [(k.i, k) for k in keys], n, cfg
             )
@@ -167,6 +174,7 @@ def test_graft_entry_single_chip():
     assert bool(out.all())
 
 
+@pytest.mark.heavy
 def test_graft_entry_dryrun():
     import __graft_entry__
 

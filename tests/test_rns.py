@@ -96,6 +96,7 @@ class TestModexp:
         assert got == pow(b, e, n)
 
 
+@pytest.mark.heavy
 def test_shared_comb_sequential_ladder(monkeypatch):
     """FSDKR_COMB_TREE=0 forces tree_chunk=1, the sequential per-window
     accumulation branch of _rns_shared_modexp_kernel. It must agree with
@@ -120,6 +121,7 @@ def test_shared_comb_sequential_ladder(monkeypatch):
     assert rns.rns_modexp_shared(gbases, gexps, gmods, bits) == want
 
 
+@pytest.mark.heavy
 def test_shared_comb_device_ladder(monkeypatch):
     """Above _DEVICE_LADDER_MIN_GROUPS the comb builds its power ladder
     on the device batch; results must match the host-ladder path / pow."""

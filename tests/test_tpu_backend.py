@@ -50,6 +50,7 @@ def _pdl_items(keys, msgs, n):
     return items
 
 
+@pytest.mark.heavy
 class TestFamilyParity:
     """Each family: host and TPU verdict vectors must be identical, on
     valid batches and on batches with tampered rows."""
@@ -160,6 +161,7 @@ class TestFamilyParity:
         assert v.verify_composite_dlog([]) == []
 
 
+@pytest.mark.heavy
 class TestCollectOnTpuBackend:
     def test_full_refresh_tpu_backend(self):
         """End-to-end: distribute on host, collect entirely through the
@@ -201,6 +203,7 @@ class TestCollectOnTpuBackend:
             RefreshMessage.collect(bad, keys[1], dks[1], (), TPU_CFG)
 
 
+@pytest.mark.heavy
 def test_launch_tiling_matches_unchunked(monkeypatch):
     """HBM tiling: chunked launches (FSDKR_MAX_ROWS_PER_LAUNCH) must be
     row-for-row identical to one launch."""
