@@ -29,7 +29,13 @@ from typing import Optional, Sequence
 import jax
 import numpy as np
 
-__all__ = ["initialize", "global_mesh", "is_multihost"]
+__all__ = [
+    "initialize",
+    "global_mesh",
+    "is_multihost",
+    "rows_to_global",
+    "gather_rows",
+]
 
 _initialized = False
 
@@ -117,6 +123,30 @@ def _backend_already_up() -> bool:
 
 def is_multihost() -> bool:
     return jax.process_count() > 1
+
+
+def rows_to_global(mesh: "jax.sharding.Mesh", local_rows, spec):
+    """Assemble a process-spanning global array from each host's row
+    block. In a multi-process deployment every host holds only its own
+    slice of the proof-row axis; the sharded kernels (parallel.
+    shard_kernels) consume global arrays laid out over the global mesh,
+    so each host contributes `local_rows` (its contiguous block, in
+    process order — matching global_mesh's host-aligned outer axis) under
+    PartitionSpec `spec`. Single-host this is just device_put with the
+    sharding."""
+    return jax.make_array_from_process_local_data(
+        jax.sharding.NamedSharding(mesh, spec), np.asarray(local_rows)
+    )
+
+
+def gather_rows(global_array) -> np.ndarray:
+    """Fetch a fully-materialized copy of a (possibly process-spanning)
+    global array on every host — the verdict-gather step after a sharded
+    verification launch. DCN traffic is exactly this gather, matching
+    SURVEY.md §5's layout (compute never crosses hosts)."""
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(global_array, tiled=True))
 
 
 def global_mesh(

@@ -1,0 +1,53 @@
+"""Multi-host (DCN) path exercised for real: a 2-process jax.distributed
+CPU cluster (Gloo transport standing in for DCN) runs the sharded
+Montgomery kernel over the host-aligned global mesh, with per-host row
+contribution and cross-host verdict gather — the layout SURVEY.md §5
+specifies for multi-slice scale-out. Round-3 coverage only tested the
+single-host degeneracy; this spawns actual processes."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.heavy
+def test_two_process_cluster_sharded_kernel():
+    worker = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+    port = _free_port()
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        # workers configure their own platform/devices; strip the suite's
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"multihost workers hung; partial output: {outs}")
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-2000:]}"
+        assert f"proc {i}: MULTIHOST-OK" in out
