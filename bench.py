@@ -126,6 +126,17 @@ def init_jax_with_retry(attempts=4, delay=15.0):
     )
 
 
+def _jax_cache_entries() -> int:
+    """Entry count of the persistent XLA compilation cache — cold-start
+    accounting: cold-minus-warm is compile+upload overhead, and the
+    entry delta says how many kernel shapes were NOT served by the
+    cache (shape-bucketing regressions show up here)."""
+    try:
+        return len(os.listdir("/root/repo/.jax_cache"))
+    except OSError:
+        return 0
+
+
 def roofline_fields(t_warm, stats=None):
     """mfu/gmacs fields for a bench JSON, from tracer stats accumulated
     during the warm run (caller resets the tracer before it), or from an
@@ -355,10 +366,16 @@ def main():
 
     # --- TPU backend: warm-up (compiles), then timed run ----------------
     log("tpu collect: warm-up (compiles cached to .jax_cache) ...")
+    cache_before = _jax_cache_entries()
     t0 = time.time()
     RefreshMessage.collect(msgs, keys[0].clone(), dks[0], (), tpu_cfg)
     t_tpu_cold = time.time() - t0
-    log(f"tpu collect cold: {t_tpu_cold:.2f}s")
+    cache_after = _jax_cache_entries()
+    log(
+        f"tpu collect cold: {t_tpu_cold:.2f}s "
+        f"(persistent cache {cache_before} -> {cache_after} entries; "
+        f"{cache_after - cache_before} fresh compiles)"
+    )
 
     get_tracer().reset()
     t0 = time.time()
@@ -465,6 +482,8 @@ def main():
         "host_native_available": native.available(),
         "collect_warm_s": round(t_tpu, 2),
         "collect_cold_s": round(t_tpu_cold, 2),
+        "compile_overhead_s": round(t_tpu_cold - t_tpu, 2),
+        "fresh_compiles": cache_after - cache_before,
         "distribute_batch_s": round(t_distribute, 2),
     }
     if trace_out:
