@@ -67,34 +67,46 @@ class BatchVerifier:
 
 
 class HostBatchVerifier(BatchVerifier):
+    def __init__(self, hash_alg: Optional[str] = None):
+        # None -> the process-default digest (core.transcript). get_backend
+        # binds the session's config.hash_alg here so interleaved sessions
+        # with different digests stay self-consistent.
+        self._hash_alg = hash_alg
+
     def verify_pdl(self, items):
         out = []
         for proof, st in items:
             try:
-                proof.verify(st)
+                proof.verify(st, hash_alg=self._hash_alg)
                 out.append(None)
             except PDLwSlackProofError as e:
                 out.append((e.is_u1_eq, e.is_u2_eq, e.is_u3_eq))
         return out
 
     def verify_range(self, items):
-        return [proof.verify(c, ek, dlog) for proof, c, ek, dlog in items]
+        return [
+            proof.verify(c, ek, dlog, hash_alg=self._hash_alg)
+            for proof, c, ek, dlog in items
+        ]
 
     def verify_ring_pedersen(self, items, m_security):
         out = []
         for proof, st in items:
             try:
-                proof.verify(st, m_security)
+                proof.verify(st, m_security, hash_alg=self._hash_alg)
                 out.append(True)
             except Exception:
                 out.append(False)
         return out
 
     def verify_correct_key(self, items, rounds):
-        return [proof.verify(ek, rounds=rounds) for proof, ek in items]
+        return [
+            proof.verify(ek, rounds=rounds, hash_alg=self._hash_alg)
+            for proof, ek in items
+        ]
 
     def verify_composite_dlog(self, items):
-        return [proof.verify(st) for proof, st in items]
+        return [proof.verify(st, hash_alg=self._hash_alg) for proof, st in items]
 
     def validate_feldman(self, items):
         return [scheme.validate_share_public(point, idx) for scheme, point, idx in items]
@@ -128,14 +140,11 @@ class TracedVerifier:
 
 def get_backend(config: ProtocolConfig) -> "TracedVerifier":
     """Returns the configured backend wrapped in a TracedVerifier (which
-    quacks like a BatchVerifier via delegation). config is REQUIRED: this
-    getter activates process-wide state (transcript digest) — a defaulted
-    call would silently reinstall sha256 over a non-sha256 session."""
-    from ..core.transcript import set_hash_algorithm
-
-    set_hash_algorithm(config.hash_alg)
+    quacks like a BatchVerifier via delegation). The session's hash_alg is
+    bound into the returned verifier — never installed process-wide — so
+    sessions with different digests can interleave in one process."""
     if config.backend == "host":
-        return TracedVerifier(HostBatchVerifier())
+        return TracedVerifier(HostBatchVerifier(config.hash_alg))
     if config.backend == "tpu":
         try:
             from .tpu_verifier import TpuBatchVerifier

@@ -299,12 +299,9 @@ def tpu_powm_grouped(bases, exps, moduli) -> List[int]:
 
 
 def get_batch_powm(config: ProtocolConfig) -> BatchPowm:
-    # config is REQUIRED: this getter activates process-wide state (mesh,
-    # transcript digest) — a defaulted call would silently reinstall
-    # sha256 over an active non-sha256 session
-    from ..core.transcript import set_hash_algorithm
-
-    set_hash_algorithm(config.hash_alg)
+    # config is REQUIRED: this getter activates the device mesh, which is
+    # genuinely process-global hardware state. The transcript digest is
+    # NOT installed here — hash_alg flows by parameter (see get_backend)
     apply_mesh(config)
     return tpu_powm_grouped if config.backend == "tpu" else host_powm
 

@@ -71,7 +71,7 @@ class TpuBatchVerifier(BatchVerifier):
 
     def __init__(self, config: ProtocolConfig = DEFAULT_CONFIG):
         self.config = config
-        self._host = HostBatchVerifier()
+        self._host = HostBatchVerifier(config.hash_alg)
         # install the device mesh described by config.mesh_shape: every
         # modexp/modmul launch below row-shards over it (backend.powm)
         from .powm import apply_mesh
@@ -84,7 +84,9 @@ class TpuBatchVerifier(BatchVerifier):
         carry state for _pdl_finish). Column order matches _pdl_finish."""
         with phase("pdl.challenge", items=len(items)):
             e_vec = [
-                PDLwSlackProof._challenge(st, p.z, p.u1, p.u2, p.u3)
+                PDLwSlackProof._challenge(
+                    st, p.z, p.u1, p.u2, p.u3, self.config.hash_alg
+                )
                 for p, st in items
             ]
         nn_mod = [st.ek.nn for _, st in items]
@@ -251,7 +253,9 @@ class TpuBatchVerifier(BatchVerifier):
                 w = w_part[idx] * z_e_inv % dlog.N
                 u = u_part[idx] * c_e_inv % ek.nn
                 out.append(
-                    alice_range._challenge(ek.n, cipher, proof.z, u, w)
+                    alice_range._challenge(
+                        ek.n, cipher, proof.z, u, w, self.config.hash_alg
+                    )
                     == proof.e
                 )
         return out
@@ -298,8 +302,8 @@ class TpuBatchVerifier(BatchVerifier):
                 shapes_ok.append(ok)
                 if not ok:
                     continue
-                e = RingPedersenProof._challenge(proof.A)
-                bits = challenge_bits(e, m_security)
+                e = RingPedersenProof._challenge(proof.A, self.config.hash_alg)
+                bits = challenge_bits(e, m_security, self.config.hash_alg)
                 for a_i, z_i, b in zip(proof.A, proof.Z, bits):
                     bases.append(st.T)
                     exps.append(z_i)
@@ -350,7 +354,10 @@ class TpuBatchVerifier(BatchVerifier):
                     exps.append(n)
                     moduli.append(n)
                     want.append(
-                        correct_key._derive_rho(n, correct_key.SALT_STRING, i)
+                        correct_key._derive_rho(
+                            n, correct_key.SALT_STRING, i,
+                            self.config.hash_alg,
+                        )
                     )
 
         with phase("correct_key.modexp", items=len(bases)):
@@ -374,7 +381,10 @@ class TpuBatchVerifier(BatchVerifier):
         from ..proofs.composite_dlog import CompositeDLogProof
         with phase("composite_dlog.challenge", items=len(items)):
             e_vec = [
-                CompositeDLogProof._challenge(p.x_commit, st) for p, st in items
+                CompositeDLogProof._challenge(
+                    p.x_commit, st, self.config.hash_alg
+                )
+                for p, st in items
             ]
         moduli = [st.N for _, st in items]
         with phase("composite_dlog.modexp", items=2 * len(items)):

@@ -39,11 +39,12 @@ class ProtocolConfig:
     backend: str = "host"
     mesh_shape: Optional[Tuple[int, ...]] = None
     # Fiat-Shamir digest (reference: generic `HashChoice<H>` type param,
-    # src/refresh_message.rs:31). Any name in core.transcript._HASHES;
-    # wider digests admit m_security > 256. One hash_alg per process:
-    # entry points install it globally (core.transcript), so every call
-    # in a session — including defaulted ones, which mean DEFAULT_CONFIG
-    # and hence sha256 — must use the same config.
+    # src/refresh_message.rs:31,46-47). Any name in core.transcript._HASHES;
+    # wider digests admit m_security > 256. Threaded by parameter from the
+    # protocol layer through every proof's prove/verify, so sessions with
+    # different digests coexist in one process; the process-global default
+    # (core.transcript.set_hash_algorithm) only covers standalone
+    # prove/verify calls made without an explicit hash_alg.
     hash_alg: str = "sha256"
     # Group (reference: generic curve `E`). The host oracle layer is
     # generic (core.curves.make_curve); the batched device EC kernels are

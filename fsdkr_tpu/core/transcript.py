@@ -4,20 +4,20 @@ Provides the `curv` `Digest`/`DigestExt` capability the reference uses for
 every NIZK challenge (`chain_bigint` / `result_bigint`, usage e.g.
 `/root/reference/src/range_proofs.rs:150-157`,
 `src/zk_pdl_with_slack.rs:87-95`, `src/ring_pedersen_proof.rs:96-105`).
-The reference is generic over the digest (`HashChoice<H>`,
-`src/refresh_message.rs:31`); here the equivalent knob is
-`ProtocolConfig.hash_alg`, installed process-wide by the protocol entry
-points via `set_hash_algorithm` (the same activation pattern as the
-device mesh) — every transcript and challenge-bit extraction then rides
-the configured digest. Wider digests (sha512, sha3_512, blake2b) raise
-the ring-Pedersen challenge capacity above 256 rounds.
+The reference is generic over the digest (`HashChoice<H>`, a per-message
+type parameter, `src/refresh_message.rs:31,46-47`); here the equivalent
+knob is `ProtocolConfig.hash_alg`, threaded BY PARAMETER from the
+protocol entry points through every proof's prove/verify into
+`Transcript(algorithm=...)` / `challenge_bits(..., algorithm)` — so
+sessions with different digests coexist and interleave in one process,
+matching the reference's per-instance binding. Wider digests (sha512,
+sha3_512, blake2b) raise the ring-Pedersen challenge capacity above 256
+rounds.
 
-Like the mesh, the knob is one-per-process: the reference's H is a
-compile-time type parameter (one digest per build), and the equivalent
-here is one `hash_alg` per process — interleaving configs with different
-digests from multiple threads is unsupported (a proof would be hashed
-under whichever config activated last). Per-call override: the
-`algorithm=` parameter on Transcript / challenge_bits.
+`set_hash_algorithm` installs only the process-wide DEFAULT, used when a
+proof is proven/verified standalone without an explicit algorithm (e.g.
+ad-hoc after deserialization). Protocol-layer correctness never depends
+on it.
 
 This framework defines its own canonical encoding (SURVEY.md §7 step 2):
 each chained value is hashed as a 4-byte big-endian length prefix followed

@@ -35,10 +35,12 @@ __all__ = ["AliceProof"]
 _DOMAIN = b"fsdkr/alice-range/v1"
 
 
-def _challenge(n: int, c: int, z: int, u: int, w: int) -> int:
+def _challenge(
+    n: int, c: int, z: int, u: int, w: int, hash_alg: str | None = None
+) -> int:
     # transcript fields mirror /root/reference/src/range_proofs.rs:150-157
     return (
-        Transcript(_DOMAIN)
+        Transcript(_DOMAIN, algorithm=hash_alg)
         .chain_int(n)
         .chain_int(n + 1)
         .chain_int(c)
@@ -65,15 +67,21 @@ class AliceProof:
         dlog_statement: DLogStatement,
         r: int,
         q: int = CURVE_ORDER,
+        hash_alg: str | None = None,
     ) -> "AliceProof":
-        return AliceProof.generate_batch([(a, cipher, alice_ek, dlog_statement, r)], q)[0]
+        return AliceProof.generate_batch(
+            [(a, cipher, alice_ek, dlog_statement, r)], q, hash_alg=hash_alg
+        )[0]
 
     # Two-phase batched prover (same protocol as PDLwSlackProof's: stage1
     # emits columns, stage2 the response column) so distribute_batch can
     # fuse both families' same-width columns into shared launches.
 
     @staticmethod
-    def generate_stage1(avals, rvals, h1v, h2v, ntv, nv, nnv, q: int = CURVE_ORDER):
+    def generate_stage1(
+        avals, rvals, h1v, h2v, ntv, nv, nnv, q: int = CURVE_ORDER,
+        hash_alg: str | None = None,
+    ):
         if q.bit_length() > 256:
             raise ValueError(
                 "SHA-256 transcripts support group orders up to 256 bits"
@@ -85,7 +93,7 @@ class AliceProof:
         rho = [secrets.randbelow(q * nt) for nt in ntv]
         state = dict(
             avals=avals, rvals=rvals, alpha=alpha, beta=beta, gamma=gamma,
-            rho=rho, ntv=ntv, nv=nv, nnv=nnv,
+            rho=rho, ntv=ntv, nv=nv, nnv=nnv, hash_alg=hash_alg,
         )
         cols = [
             (h1v, avals, ntv),
@@ -107,7 +115,7 @@ class AliceProof:
         w = intops.mod_mul_col(c3, c4, ntv)
         u = paillier.combine_with_rn(alpha, bn, nv, nnv)  # Enc(alpha; beta)
         e = [
-            _challenge(n, cipher, zi, ui, wi)
+            _challenge(n, cipher, zi, ui, wi, state["hash_alg"])
             for cipher, n, zi, ui, wi in zip(ciphers, nv, z, u, w)
         ]
         state.update(z=z, e=e)
@@ -136,7 +144,9 @@ class AliceProof:
         return proofs
 
     @staticmethod
-    def generate_batch(items, q: int = CURVE_ORDER, powm=None) -> list["AliceProof"]:
+    def generate_batch(
+        items, q: int = CURVE_ORDER, powm=None, hash_alg: str | None = None
+    ) -> list["AliceProof"]:
         """Batched prover over items = [(a, cipher, ek, dlog_statement, r)].
 
         The per-receiver fan-out of distribute (reference
@@ -157,6 +167,7 @@ class AliceProof:
             [ek.n for _, _, ek, _, _ in items],
             [ek.nn for _, _, ek, _, _ in items],
             q,
+            hash_alg,
         )
         state, cols2 = AliceProof.generate_stage2(
             state, powm_columns(powm, *cols), [c for _, c, _, _, _ in items]
@@ -169,6 +180,7 @@ class AliceProof:
         alice_ek: EncryptionKey,
         dlog_statement: DLogStatement,
         q: int = CURVE_ORDER,
+        hash_alg: str | None = None,
     ) -> bool:
         h1, h2, n_tilde = dlog_statement.g, dlog_statement.ni, dlog_statement.N
         n, nn = alice_ek.n, alice_ek.nn
@@ -193,4 +205,4 @@ class AliceProof:
         gs1 = (1 + self.s1 * n) % nn
         u = gs1 * intops.mod_pow(self.s, n, nn) * cipher_e_inv % nn
 
-        return _challenge(n, cipher, self.z, u, w) == self.e
+        return _challenge(n, cipher, self.z, u, w, hash_alg) == self.e

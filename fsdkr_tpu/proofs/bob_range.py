@@ -41,10 +41,11 @@ def _challenge(
     v: int,
     w: int,
     check: Optional[tuple[Point, Point]],
+    hash_alg: str | None = None,
 ) -> int:
     # transcript fields mirror /root/reference/src/range_proofs.rs:415-439
     tr = (
-        Transcript(_DOMAIN)
+        Transcript(_DOMAIN, algorithm=hash_alg)
         .chain_int(n)
         .chain_int(n + 1)
         .chain_int(a_enc)
@@ -83,6 +84,7 @@ class BobProof:
         dlog_statement: DLogStatement,
         r: int,
         check: bool = False,
+        hash_alg: str | None = None,
     ) -> tuple["BobProof", Optional[Point]]:
         q = CURVE_ORDER
         h1, h2, n_tilde = dlog_statement.g, dlog_statement.ni, dlog_statement.N
@@ -117,7 +119,10 @@ class BobProof:
             u_point = Point.generator() * Scalar.from_int(alpha)
             check_pair = (X, u_point)
 
-        e = _challenge(n, a_encrypted, mta_encrypted, z, z_prim, t, v, w, check_pair)
+        e = _challenge(
+            n, a_encrypted, mta_encrypted, z, z_prim, t, v, w, check_pair,
+            hash_alg,
+        )
 
         # round 2 (reference :313-336)
         proof = BobProof(
@@ -143,6 +148,7 @@ class BobProof:
         alice_ek: EncryptionKey,
         dlog_statement: DLogStatement,
         check: Optional[tuple[Point, Point]] = None,
+        hash_alg: str | None = None,
     ) -> bool:
         q = CURVE_ORDER
         h1, h2, n_tilde = dlog_statement.g, dlog_statement.ni, dlog_statement.N
@@ -172,7 +178,13 @@ class BobProof:
             return False
         w = intops.mod_pow(h1, self.t1, n_tilde) * intops.mod_pow(h2, self.t2, n_tilde) * t_e_inv % n_tilde
 
-        return _challenge(n, a_enc, mta_avc_out, self.z, z_prim, self.t, v, w, check) == self.e
+        return (
+            _challenge(
+                n, a_enc, mta_avc_out, self.z, z_prim, self.t, v, w, check,
+                hash_alg,
+            )
+            == self.e
+        )
 
 
 @dataclass(frozen=True)
@@ -192,6 +204,7 @@ class BobProofExt:
         alice_ek: EncryptionKey,
         dlog_statement: DLogStatement,
         r: int,
+        hash_alg: str | None = None,
     ) -> "BobProofExt":
         proof, u = BobProof.generate(
             a_encrypted,
@@ -202,6 +215,7 @@ class BobProofExt:
             dlog_statement,
             r,
             check=True,
+            hash_alg=hash_alg,
         )
         assert u is not None
         return BobProofExt(proof=proof, u=u)
@@ -213,9 +227,11 @@ class BobProofExt:
         alice_ek: EncryptionKey,
         dlog_statement: DLogStatement,
         X: Point,
+        hash_alg: str | None = None,
     ) -> bool:
         if not self.proof.verify(
-            a_enc, mta_avc_out, alice_ek, dlog_statement, check=(X, self.u)
+            a_enc, mta_avc_out, alice_ek, dlog_statement, check=(X, self.u),
+            hash_alg=hash_alg,
         ):
             return False
         # EC consistency: s1*G == e*X + u (reference :549-560)

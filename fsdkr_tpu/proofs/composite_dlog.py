@@ -47,9 +47,11 @@ class CompositeDLogProof:
     y: int  # integer response
 
     @staticmethod
-    def _challenge(x_commit: int, st: DLogStatement) -> int:
+    def _challenge(
+        x_commit: int, st: DLogStatement, hash_alg: str | None = None
+    ) -> int:
         return (
-            Transcript(_DOMAIN)
+            Transcript(_DOMAIN, algorithm=hash_alg)
             .chain_int(x_commit)
             .chain_int(st.g)
             .chain_int(st.N)
@@ -58,15 +60,17 @@ class CompositeDLogProof:
         )
 
     @staticmethod
-    def prove(st: DLogStatement, secret_x: int) -> "CompositeDLogProof":
+    def prove(
+        st: DLogStatement, secret_x: int, hash_alg: str | None = None
+    ) -> "CompositeDLogProof":
         r = secrets.randbelow(st.N << STAT_BITS)
         x_commit = intops.mod_pow(st.g, r, st.N)
-        e = CompositeDLogProof._challenge(x_commit, st)
+        e = CompositeDLogProof._challenge(x_commit, st, hash_alg)
         return CompositeDLogProof(x_commit=x_commit, y=r + e * secret_x)
 
-    def verify(self, st: DLogStatement) -> bool:
+    def verify(self, st: DLogStatement, hash_alg: str | None = None) -> bool:
         if not (0 < self.x_commit < st.N) or self.y < 0:
             return False
-        e = CompositeDLogProof._challenge(self.x_commit, st)
+        e = CompositeDLogProof._challenge(self.x_commit, st, hash_alg)
         lhs = intops.mod_pow(st.g, self.y, st.N) * intops.mod_pow(st.ni, e, st.N) % st.N
         return lhs == self.x_commit

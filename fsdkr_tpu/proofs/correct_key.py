@@ -37,14 +37,16 @@ SALT_STRING = b"fsdkr/correct-key/salt/v1"
 _DOMAIN = b"fsdkr/correct-key/v1"
 
 
-def _derive_rho(n: int, salt: bytes, index: int) -> int:
+def _derive_rho(
+    n: int, salt: bytes, index: int, hash_alg: str | None = None
+) -> int:
     """Hash-expand (N, salt, index) to |N|+128 bits, reduce mod N."""
     need_bytes = (n.bit_length() + 127) // 8 + 16
     out = b""
     counter = 0
     while len(out) < need_bytes:
         out += (
-            Transcript(_DOMAIN)
+            Transcript(_DOMAIN, algorithm=hash_alg)
             .chain_int(n)
             .chain_bytes(salt)
             .chain_int(index)
@@ -65,8 +67,9 @@ class NiCorrectKeyProof:
         salt: bytes = SALT_STRING,
         rounds: int = DEFAULT_CONFIG.correct_key_rounds,
         powm=None,
+        hash_alg: str | None = None,
     ) -> "NiCorrectKeyProof":
-        return NiCorrectKeyProof.proof_batch([dk], salt, rounds, powm)[0]
+        return NiCorrectKeyProof.proof_batch([dk], salt, rounds, powm, hash_alg)[0]
 
     @staticmethod
     def proof_batch(
@@ -74,6 +77,7 @@ class NiCorrectKeyProof:
         salt: bytes = SALT_STRING,
         rounds: int = DEFAULT_CONFIG.correct_key_rounds,
         powm=None,
+        hash_alg: str | None = None,
     ) -> List["NiCorrectKeyProof"]:
         """All provers' N-th-root columns in ONE modexp launch (the
         cross-sender batch axis of a refresh, SURVEY.md §1)."""
@@ -84,7 +88,7 @@ class NiCorrectKeyProof:
             n = dk.p * dk.q
             phi = (dk.p - 1) * (dk.q - 1)
             d = pow(n, -1, phi)  # x -> x^d inverts x -> x^N on Z_N^*
-            bases += [_derive_rho(n, salt, i) for i in range(rounds)]
+            bases += [_derive_rho(n, salt, i, hash_alg) for i in range(rounds)]
             exps += [d] * rounds
             mods += [n] * rounds
         sigma = powm(bases, exps, mods)
@@ -98,6 +102,7 @@ class NiCorrectKeyProof:
         ek: EncryptionKey,
         salt: bytes = SALT_STRING,
         rounds: int = DEFAULT_CONFIG.correct_key_rounds,
+        hash_alg: str | None = None,
     ) -> bool:
         n = ek.n
         if len(self.sigma_vec) != rounds:
@@ -108,6 +113,6 @@ class NiCorrectKeyProof:
         for i, sigma in enumerate(self.sigma_vec):
             if not (0 < sigma < n):
                 return False
-            if intops.mod_pow(sigma, n, n) != _derive_rho(n, salt, i):
+            if intops.mod_pow(sigma, n, n) != _derive_rho(n, salt, i, hash_alg):
                 return False
         return True

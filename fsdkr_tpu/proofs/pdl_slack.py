@@ -76,10 +76,13 @@ class PDLwSlackProof:
     s3: int
 
     @staticmethod
-    def _challenge(st: PDLwSlackStatement, z: int, u1: Point, u2: int, u3: int) -> int:
+    def _challenge(
+        st: PDLwSlackStatement, z: int, u1: Point, u2: int, u3: int,
+        hash_alg: str | None = None,
+    ) -> int:
         # transcript fields mirror /root/reference/src/zk_pdl_with_slack.rs:87-95
         return (
-            Transcript(_DOMAIN)
+            Transcript(_DOMAIN, algorithm=hash_alg)
             .chain_point(st.G)
             .chain_point(st.Q)
             .chain_int(st.ciphertext)
@@ -91,8 +94,12 @@ class PDLwSlackProof:
         )
 
     @staticmethod
-    def prove(witness: PDLwSlackWitness, st: PDLwSlackStatement) -> "PDLwSlackProof":
-        return PDLwSlackProof.prove_batch([witness], [st])[0]
+    def prove(
+        witness: PDLwSlackWitness,
+        st: PDLwSlackStatement,
+        hash_alg: str | None = None,
+    ) -> "PDLwSlackProof":
+        return PDLwSlackProof.prove_batch([witness], [st], hash_alg=hash_alg)[0]
 
     # Two-phase batched prover: stage1 emits the modexp columns of the
     # round-1 commitments, stage2 (after the fused launch) emits the
@@ -102,7 +109,7 @@ class PDLwSlackProof:
     # depth, not row count, prices a launch (backend.powm.powm_columns).
 
     @staticmethod
-    def prove_stage1(witnesses, h1v, h2v, ntv, nv, nnv):
+    def prove_stage1(witnesses, h1v, h2v, ntv, nv, nnv, hash_alg=None):
         """Sample nonces, return (state, columns): 4 commitment columns
         mod N~ plus the beta^n column mod n^2."""
         q = CURVE_ORDER
@@ -113,7 +120,7 @@ class PDLwSlackProof:
         gamma = [secrets.randbelow(q3 * nt) for nt in ntv]
         state = dict(
             witnesses=witnesses, alpha=alpha, beta=beta, rho=rho, gamma=gamma,
-            ntv=ntv, nv=nv, nnv=nnv,
+            ntv=ntv, nv=nv, nnv=nnv, hash_alg=hash_alg,
         )
         cols = [
             (h1v, [w.x.to_int() for w in witnesses], ntv),
@@ -145,7 +152,7 @@ class PDLwSlackProof:
         else:
             u1 = [st.G * Scalar.from_int(al) for st, al in zip(statements, alpha)]
         e = [
-            PDLwSlackProof._challenge(st, zi, u1i, u2i, u3i)
+            PDLwSlackProof._challenge(st, zi, u1i, u2i, u3i, state["hash_alg"])
             for st, zi, u1i, u2i, u3i in zip(statements, z, u1, u2, u3)
         ]
         state.update(z=z, u1=u1, u2=u2, u3=u3, e=e)
@@ -182,6 +189,7 @@ class PDLwSlackProof:
         statements: list[PDLwSlackStatement],
         powm=None,
         device_ec: bool = False,
+        hash_alg: str | None = None,
     ) -> list["PDLwSlackProof"]:
         """Batched prover: the n-receiver fan-out of distribute (reference
         `/root/reference/src/refresh_message.rs:87-104`) as modexp columns
@@ -206,16 +214,19 @@ class PDLwSlackProof:
             [st.N_tilde for st in statements],
             [st.ek.n for st in statements],
             [st.ek.nn for st in statements],
+            hash_alg,
         )
         state, cols2 = PDLwSlackProof.prove_stage2(
             state, powm_columns(powm, *cols), statements, device_ec
         )
         return PDLwSlackProof.prove_finish(state, powm_columns(powm, *cols2))
 
-    def verify(self, st: PDLwSlackStatement) -> None:
+    def verify(self, st: PDLwSlackStatement, hash_alg: str | None = None) -> None:
         """Raises PDLwSlackProofError with per-equation booleans on failure
         (reference `src/zk_pdl_with_slack.rs:158-166`)."""
-        e = PDLwSlackProof._challenge(st, self.z, self.u1, self.u2, self.u3)
+        e = PDLwSlackProof._challenge(
+            st, self.z, self.u1, self.u2, self.u3, hash_alg
+        )
 
         g_s1 = st.G * Scalar.from_int(self.s1)
         e_neg = Scalar.from_int(CURVE_ORDER - e % CURVE_ORDER)

@@ -68,8 +68,8 @@ class RingPedersenProof:
     Z: List[int]
 
     @staticmethod
-    def _challenge(a_vec: List[int]) -> int:
-        t = Transcript(_DOMAIN)
+    def _challenge(a_vec: List[int], hash_alg: str | None = None) -> int:
+        t = Transcript(_DOMAIN, algorithm=hash_alg)
         for a_i in a_vec:
             t.chain_int(a_i)
         return t.result_int()
@@ -80,8 +80,11 @@ class RingPedersenProof:
         st: RingPedersenStatement,
         m_security: int = DEFAULT_CONFIG.m_security,
         powm=None,
+        hash_alg: str | None = None,
     ) -> "RingPedersenProof":
-        return RingPedersenProof.prove_batch([witness], [st], m_security, powm)[0]
+        return RingPedersenProof.prove_batch(
+            [witness], [st], m_security, powm, hash_alg
+        )[0]
 
     @staticmethod
     def prove_batch(
@@ -89,6 +92,7 @@ class RingPedersenProof:
         statements: List[RingPedersenStatement],
         m_security: int = DEFAULT_CONFIG.m_security,
         powm=None,
+        hash_alg: str | None = None,
     ) -> List["RingPedersenProof"]:
         """All provers' M-round commitment columns in ONE modexp launch;
         each prover's rows share (T, N), so the fixed-base comb kernel
@@ -112,8 +116,8 @@ class RingPedersenProof:
         out = []
         for k, (witness, a_vec) in enumerate(zip(witnesses, a_all)):
             A_vec = A_all[k * m_security : (k + 1) * m_security]
-            e = RingPedersenProof._challenge(A_vec)
-            bits = challenge_bits(e, m_security)
+            e = RingPedersenProof._challenge(A_vec, hash_alg)
+            bits = challenge_bits(e, m_security, hash_alg)
             Z_vec = [
                 (a_i + (witness.lam if b else 0)) % witness.phi
                 for a_i, b in zip(a_vec, bits)
@@ -126,13 +130,14 @@ class RingPedersenProof:
         self,
         st: RingPedersenStatement,
         m_security: int = DEFAULT_CONFIG.m_security,
+        hash_alg: str | None = None,
     ) -> None:
         """Per-bit check T^{Z_i} == A_i * S^{e_i} mod N
         (reference `src/ring_pedersen_proof.rs:138-155`)."""
         if len(self.A) != m_security or len(self.Z) != m_security:
             raise RingPedersenProofError()
-        e = RingPedersenProof._challenge(self.A)
-        bits = challenge_bits(e, m_security)
+        e = RingPedersenProof._challenge(self.A, hash_alg)
+        bits = challenge_bits(e, m_security, hash_alg)
         for a_i, z_i, b in zip(self.A, self.Z, bits):
             lhs = intops.mod_pow(st.T, z_i, st.N)
             rhs = a_i * (st.S if b else 1) % st.N

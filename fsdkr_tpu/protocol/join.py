@@ -55,19 +55,21 @@ class JoinMessage:
     ) -> tuple["JoinMessage", PaillierKeyPair]:
         """New-party sender path (reference :101-124): three independent
         modulus generations (Paillier pair, h1/h2/N-tilde, ring-Pedersen)."""
-        from ..core.transcript import set_hash_algorithm
         from .keygen import create_paillier_keypair, generate_dlog_statement_proofs
 
-        set_hash_algorithm(config.hash_alg)
         pair = create_paillier_keypair(config)
         dlog_statement, proof_h1, proof_h2 = generate_dlog_statement_proofs(config)
         rp_statement, rp_witness = RingPedersenStatement.generate(config)
-        rp_proof = RingPedersenProof.prove(rp_witness, rp_statement, config.m_security)
+        rp_proof = RingPedersenProof.prove(
+            rp_witness, rp_statement, config.m_security,
+            hash_alg=config.hash_alg,
+        )
 
         msg = JoinMessage(
             ek=pair.ek,
             dk_correctness_proof=NiCorrectKeyProof.proof(
-                pair.dk, rounds=config.correct_key_rounds
+                pair.dk, rounds=config.correct_key_rounds,
+                hash_alg=config.hash_alg,
             ),
             party_index=None,
             dlog_statement=dlog_statement,

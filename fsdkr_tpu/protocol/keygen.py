@@ -47,16 +47,13 @@ def generate_dlog_statement_proofs(
 ) -> tuple[DLogStatement, CompositeDLogProof, CompositeDLogProof]:
     """DLogStatement + composite-dlog proofs in both base directions
     (reference `/root/reference/src/add_party_message.rs:69-92`)."""
-    from ..core.transcript import set_hash_algorithm
-
-    set_hash_algorithm(config.hash_alg)
     n_tilde, h1, h2, xhi, xhi_inv = generate_h1_h2_n_tilde(config)
     st_h1 = DLogStatement(N=n_tilde, g=h1, ni=h2)
     st_h2 = DLogStatement(N=n_tilde, g=h2, ni=h1)
     return (
         st_h1,
-        CompositeDLogProof.prove(st_h1, xhi),
-        CompositeDLogProof.prove(st_h2, xhi_inv),
+        CompositeDLogProof.prove(st_h1, xhi, config.hash_alg),
+        CompositeDLogProof.prove(st_h2, xhi_inv, config.hash_alg),
     )
 
 

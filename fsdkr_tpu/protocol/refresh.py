@@ -211,11 +211,12 @@ class RefreshMessage:
 
         with phase("distribute.prove_stage1", items=len(flat_rand)):
             pdl_state, pdl_cols = PDLwSlackProof.prove_stage1(
-                flat_witnesses, flat_h1, flat_h2, flat_nt, flat_nv, flat_nnv
+                flat_witnesses, flat_h1, flat_h2, flat_nt, flat_nv, flat_nnv,
+                hash_alg=config.hash_alg,
             )
             alice_state, alice_cols = AliceProof.generate_stage1(
                 flat_share_ints, flat_rand, flat_h1, flat_h2, flat_nt,
-                flat_nv, flat_nnv,
+                flat_nv, flat_nnv, hash_alg=config.hash_alg,
             )
             enc_col = (flat_rand, flat_nv, flat_nnv)  # r^n mod n^2
             res1 = powm_columns(powm, enc_col, *pdl_cols, *alice_cols)
@@ -274,11 +275,13 @@ class RefreshMessage:
             rp = [RingPedersenStatement.generate(config) for _ in per]
         with phase("distribute.correct_key_prove", items=len(per)):
             ck_proofs = NiCorrectKeyProof.proof_batch(
-                [dk for _, dk in ek_dk], rounds=config.correct_key_rounds, powm=powm
+                [dk for _, dk in ek_dk], rounds=config.correct_key_rounds,
+                powm=powm, hash_alg=config.hash_alg,
             )
         with phase("distribute.ring_pedersen_prove", items=len(per)):
             rp_proofs = RingPedersenProof.prove_batch(
-                [w for _, w in rp], [st for st, _ in rp], config.m_security, powm
+                [w for _, w in rp], [st for st, _ in rp], config.m_security,
+                powm, config.hash_alg,
             )
 
         out = []

@@ -32,9 +32,16 @@ from fsdkr_tpu.config import TEST_CONFIG  # noqa: E402
 # Pedersen moduli at 768 bits); most tests just need *a* valid committee.
 # Cache the first result per (t, n, config) and hand out deepcopies — tests
 # mutate LocalKeys (refresh rotates shares in place, collect zeroizes dks),
-# so each test gets a private copy of an identical committee. Disable with
-# FSDKR_TEST_KEYGEN_CACHE=0 for tests that need fresh randomness.
+# so each test gets a private copy of an identical committee.
+#
+# Sharing is visible and escapable at the test site: mark a test
+# @pytest.mark.fresh_committees to bypass the cache for that test (every
+# simulate_keygen call inside it generates fresh randomness), or call
+# simulate_keygen.uncached directly. Disable globally with
+# FSDKR_TEST_KEYGEN_CACHE=0.
 # ---------------------------------------------------------------------------
+_keygen_cache_bypassed = False
+
 if os.environ.get("FSDKR_TEST_KEYGEN_CACHE", "1").lower() not in (
     "",
     "0",
@@ -51,19 +58,14 @@ if os.environ.get("FSDKR_TEST_KEYGEN_CACHE", "1").lower() not in (
     _keygen_cache: dict = {}
 
     def _cached_simulate_keygen(t, n, *args, **kwargs):
+        if _keygen_cache_bypassed:
+            return _real_simulate_keygen(t, n, *args, **kwargs)
         # pass config through untouched so the wrapped function's own
         # default (DEFAULT_CONFIG) applies identically with cache on/off
         config = args[0] if args else kwargs.get("config")
         key = (t, n, repr(config))  # content key: configs are dataclasses
         if key not in _keygen_cache:
             _keygen_cache[key] = _real_simulate_keygen(t, n, *args, **kwargs)
-        else:
-            # replicate the real keygen's process-wide side effect on
-            # cache hits, or global digest state would depend on cache
-            from fsdkr_tpu.config import DEFAULT_CONFIG
-            from fsdkr_tpu.core.transcript import set_hash_algorithm
-
-            set_hash_algorithm((config or DEFAULT_CONFIG).hash_alg)
         return copy.deepcopy(_keygen_cache[key])
 
     # tests that NEED independent committees (e.g. cross-session row
@@ -78,6 +80,21 @@ if os.environ.get("FSDKR_TEST_KEYGEN_CACHE", "1").lower() not in (
         _simulation.simulate_keygen = _cached_simulate_keygen
 
 
+@pytest.fixture(autouse=True)
+def _keygen_cache_marker(request):
+    """Honor @pytest.mark.fresh_committees: bypass the session keygen
+    cache for the marked test."""
+    global _keygen_cache_bypassed
+    if request.node.get_closest_marker("fresh_committees") is None:
+        yield
+        return
+    _keygen_cache_bypassed = True
+    try:
+        yield
+    finally:
+        _keygen_cache_bypassed = False
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: full-size security parameters; excluded from quick runs"
@@ -86,6 +103,11 @@ def pytest_configure(config):
         "markers",
         "heavy: minutes-long kernel differentials / mesh compiles; excluded "
         "from the smoke gate (scripts/ci.sh) but part of the quick suite",
+    )
+    config.addinivalue_line(
+        "markers",
+        "fresh_committees: bypass the session-scoped keygen cache — every "
+        "simulate_keygen call in the test generates a fresh committee",
     )
 
 

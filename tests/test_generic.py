@@ -47,7 +47,8 @@ class TestHashChoice:
 
     def test_refresh_end_to_end_under_sha3_512(self):
         """Full refresh with every Fiat-Shamir transcript on sha3-512 —
-        prover and verifier agree through the config knob alone."""
+        prover and verifier agree through the config knob alone, without
+        touching the process-default digest."""
         from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
 
         cfg = ProtocolConfig(
@@ -63,7 +64,54 @@ class TestHashChoice:
             msgs.append(m)
             dks.append(dk)
         RefreshMessage.collect(msgs, keys[0], dks[0], (), cfg)
-        assert get_hash_algorithm() == "sha3_512"
+        # hash_alg flows by parameter, not by global installation
+        # (reference: per-message HashChoice<H>, src/refresh_message.rs:31)
+        assert get_hash_algorithm() == "sha256"
+
+    def test_two_digests_interleaved_in_one_process(self):
+        """Two committees with different transcript digests refresh with
+        their protocol steps interleaved — per-instance digest binding
+        (reference: H is a per-message type parameter,
+        src/refresh_message.rs:31,46-47)."""
+        from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+
+        cfg_a = ProtocolConfig(
+            paillier_bits=768, m_security=32, correct_key_rounds=3
+        )  # sha256
+        cfg_b = ProtocolConfig(
+            paillier_bits=768,
+            m_security=32,
+            correct_key_rounds=3,
+            hash_alg="sha3_512",
+        )
+        keys_a = simulate_keygen(1, 3, cfg_a)
+        keys_b = simulate_keygen(1, 3, cfg_b)
+
+        # interleave the distribute phases of the two sessions
+        msgs_a, dks_a = [], []
+        msgs_b, dks_b = [], []
+        for ka, kb in zip(keys_a, keys_b):
+            ma, da = RefreshMessage.distribute(ka.i, ka, 3, cfg_a)
+            mb, db = RefreshMessage.distribute(kb.i, kb, 3, cfg_b)
+            msgs_a.append(ma)
+            dks_a.append(da)
+            msgs_b.append(mb)
+            dks_b.append(db)
+
+        # interleave the collects; both must verify under their own digest
+        RefreshMessage.collect(msgs_b, keys_b[0], dks_b[0], (), cfg_b)
+        RefreshMessage.collect(msgs_a, keys_a[0], dks_a[0], (), cfg_a)
+        RefreshMessage.collect(msgs_b, keys_b[1], dks_b[1], (), cfg_b)
+
+        # cross-session verification fails: session A's proofs do not
+        # verify under session B's digest
+        from fsdkr_tpu.backend import get_backend
+
+        backend_b = get_backend(cfg_b)
+        rp_items = [
+            (m.ring_pedersen_proof, m.ring_pedersen_statement) for m in msgs_a
+        ]
+        assert not any(backend_b.verify_ring_pedersen(rp_items, 32))
 
     def test_cross_hash_verification_fails(self):
         """A proof generated under one digest must not verify under
