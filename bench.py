@@ -96,7 +96,7 @@ def init_jax_with_retry(attempts=4, delay=15.0):
     import jax
 
     try:
-        jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+        jax.config.update("jax_compilation_cache_dir", _jax_cache_dir())
     except Exception:
         pass
     # BENCH_PLATFORM=cpu runs the bench flow off-chip (smoke-testing the
@@ -126,13 +126,23 @@ def init_jax_with_retry(attempts=4, delay=15.0):
     )
 
 
+def _jax_cache_dir() -> str:
+    """Repo-relative persistent compilation cache (overridable via
+    FSDKR_JAX_CACHE), derived from this file's location instead of a
+    hardcoded absolute path."""
+    return os.environ.get(
+        "FSDKR_JAX_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+
+
 def _jax_cache_entries() -> int:
     """Entry count of the persistent XLA compilation cache — cold-start
     accounting: cold-minus-warm is compile+upload overhead, and the
     entry delta says how many kernel shapes were NOT served by the
     cache (shape-bucketing regressions show up here)."""
     try:
-        return len(os.listdir("/root/repo/.jax_cache"))
+        return len(os.listdir(_jax_cache_dir()))
     except OSError:
         return 0
 

@@ -28,6 +28,17 @@ extern "C" {
 // ---------------------------------------------------------------------------
 // limb helpers
 
+// Volatile wipe that the optimizer cannot elide: secret-bearing limb
+// buffers (exponents, secret-derived bases and their power tables, prime
+// candidates) are zeroed before frames return — the native-side
+// equivalent of the reference's zeroize discipline
+// (/root/reference/src/refresh_message.rs:446-448).
+static void secure_wipe(u64 *p, int L) {
+  volatile u64 *vp = p;
+  for (int i = 0; i < L; i++)
+    vp[i] = 0;
+}
+
 static int cmp_limbs(const u64 *a, const u64 *b, int L) {
   for (int i = L - 1; i >= 0; i--) {
     if (a[i] < b[i])
@@ -172,6 +183,9 @@ int fsdkr_modexp(const u64 *base, const u64 *exp, const u64 *n, u64 *out,
     std::memset(onev, 0, sizeof(u64) * L);
     onev[0] = 1;
     mont_mul(out, out, onev, n, n0inv, L); // leave Montgomery domain -> 1
+    secure_wipe(b, L);
+    secure_wipe(base_m, L);
+    secure_wipe(&table[0][0], 16 * MAXL);
     return 0;
   }
 
@@ -190,6 +204,10 @@ int fsdkr_modexp(const u64 *base, const u64 *exp, const u64 *n, u64 *out,
   std::memset(onev, 0, sizeof(u64) * L);
   onev[0] = 1;
   mont_mul(out, acc, onev, n, n0inv, L);
+  secure_wipe(b, L);
+  secure_wipe(base_m, L);
+  secure_wipe(&table[0][0], 16 * MAXL);
+  secure_wipe(acc, L);
   return 0;
 }
 
@@ -224,10 +242,11 @@ int fsdkr_miller_rabin(const u64 *n, int L, const u64 *witnesses, int rounds) {
   u64 n1_m[MAXL]; // n-1 in Montgomery form, for comparisons
   mont_mul(n1_m, n1, r2, n, n0inv, L);
 
+  u64 a_m[MAXL];
+  u64 ared[MAXL];
+  u64 x[MAXL];
   for (int round = 0; round < rounds; round++) {
     const u64 *a = witnesses + (size_t)round * L;
-    u64 a_m[MAXL];
-    u64 ared[MAXL];
     std::memcpy(ared, a, sizeof(u64) * L);
     while (cmp_limbs(ared, n, L) >= 0)
       sub_limbs(ared, ared, n, L);
@@ -242,7 +261,6 @@ int fsdkr_miller_rabin(const u64 *n, int L, const u64 *witnesses, int rounds) {
             top_bit = i * 64 + bit;
             break;
           }
-    u64 x[MAXL];
     std::memcpy(x, one_m, sizeof(u64) * L);
     for (int bit = top_bit; bit >= 0; bit--) {
       mont_mul(x, x, x, n, n0inv, L);
@@ -260,9 +278,29 @@ int fsdkr_miller_rabin(const u64 *n, int L, const u64 *witnesses, int rounds) {
         break;
       }
     }
-    if (witness)
+    if (witness) {
+      secure_wipe(d, L);
+      secure_wipe(n1, L);
+      secure_wipe(n1_m, L);
+      secure_wipe(x, L);
+      secure_wipe(a_m, L);
+      secure_wipe(ared, L);
+      // one_m/r2 are R mod n and R^2 mod n with R public: n is
+      // recoverable from either (gcd(R - one_m, R^2 - r2)), so they are
+      // as secret as the prime candidate itself
+      secure_wipe(one_m, L);
+      secure_wipe(r2, L);
       return 0; // composite
+    }
   }
+  secure_wipe(d, L);
+  secure_wipe(n1, L);
+  secure_wipe(n1_m, L);
+  secure_wipe(x, L);
+  secure_wipe(a_m, L);
+  secure_wipe(ared, L);
+  secure_wipe(one_m, L);
+  secure_wipe(r2, L);
   return 1; // probable prime
 }
 

@@ -10,8 +10,33 @@ the feature-flag pattern with a first-class object instead.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, replace
 from typing import Optional, Tuple
+
+_accel_probe: Optional[bool] = None
+
+
+def _accelerator_present() -> bool:
+    """Whether the default JAX backend is an accelerator. Measured EC
+    crossover (bench_results/ec_ab_cpu.json): the batched complete-law
+    EC kernels lose 3-20x to the host Jacobian oracle on XLA:CPU at
+    every protocol shape, so EC rides the device only when a real
+    accelerator is behind JAX.
+
+    Only a successful jax.devices() probe is cached: TPU backend init is
+    flaky in this environment (bench.py retries it), and pinning a
+    transient failure would silently lock EC routing to the host for the
+    whole process."""
+    global _accel_probe
+    if _accel_probe is None:
+        try:
+            import jax
+
+            _accel_probe = jax.devices()[0].platform != "cpu"
+        except Exception:
+            return False  # transient: do not cache
+    return _accel_probe
 
 
 @dataclass(frozen=True)
@@ -82,9 +107,23 @@ class ProtocolConfig:
     @property
     def device_ec(self) -> bool:
         """Whether EC hot paths (commit-point fan-out, PDL u1 column,
-        pk_vec MSM) run on the accelerator. Single dispatch point for
-        the protocol layer — mirrors get_batch_powm's backend switch."""
-        return self.backend == "tpu"
+        Feldman RLC checks, pk_vec MSM) run on the accelerator. Single
+        dispatch point for the protocol layer and the batch verifier.
+
+        Routing: off for the host backend; for backend="tpu",
+        FSDKR_DEVICE_EC=1/0 forces the device/host route, and the
+        default (auto) picks the device only when JAX is actually
+        backed by an accelerator — on the XLA:CPU fallback platform the
+        host Jacobian oracle beats the batched kernels at every
+        protocol shape (bench_results/ec_ab_cpu.json)."""
+        if self.backend != "tpu":
+            return False
+        env = os.environ.get("FSDKR_DEVICE_EC", "auto").lower()
+        if env in ("0", "off", "false", "no"):
+            return False
+        if env in ("1", "on", "true", "yes"):
+            return True
+        return _accelerator_present()
 
     @property
     def prime_bits(self) -> int:

@@ -273,7 +273,33 @@ def make_curve(params: CurveParams) -> SimpleNamespace:
         z3 = (2 * h * z1) % P
         return x3, y3, z3
 
+    # --- parameter validation: unsupported curves fail HERE, not at the
+    # first from_bytes / share-combine deep inside a protocol run -------
+    if P % 4 != 3:
+        # compressed-point decode uses the p = 3 (mod 4) square root
+        # shortcut; reject at registration rather than on first decode
+        raise ValueError(
+            f"{params.name}: field prime must be 3 mod 4 (compressed-point "
+            "sqrt); Tonelli-Shanks fields are unsupported"
+        )
+    if (params.gy**2 - (params.gx**3 + A * params.gx + B)) % P:
+        raise ValueError(f"{params.name}: generator not on curve")
+    # cofactor-1 check (the VSS/ECDSA layers assume a prime-order group
+    # with no small subgroup): ord(G) | #E and n*G = identity with n prime
+    # gives ord(G) = n; Hasse bounds #E <= p + 1 + 2*sqrt(p), so
+    # 2n > p + 1 + 2*sqrt(p) forces #E = n exactly (cofactor 1).
+    import math
+
+    if 2 * N <= P + 1 + 2 * math.isqrt(P) + 1:
+        raise ValueError(
+            f"{params.name}: group order too small for a cofactor-1 curve"
+        )
+
     GENERATOR = Point(params.gx, params.gy)
+    # ord(G) == n without tripping Scalar's mod-n reduction (G * n would
+    # compute 0*G and pass for ANY n): (n-1)*G + G must be the identity
+    if not ((GENERATOR * (N - 1)) + GENERATOR).infinity:
+        raise ValueError(f"{params.name}: generator order is not n")
     return SimpleNamespace(
         name=params.name,
         params=params,

@@ -108,10 +108,25 @@ def _limbs_for(x: int) -> int:
 
 
 def _to_buf(xs: Sequence[int], limbs: int) -> ctypes.Array:
-    buf = bytearray()
-    for x in xs:
-        buf += x.to_bytes(limbs * _LIMB_BYTES, "little")
-    return (ctypes.c_uint64 * (len(xs) * limbs)).from_buffer_copy(bytes(buf))
+    """Limb staging for the C ABI. The bytearray is wiped in place before
+    returning (no immutable `bytes` copy is ever made), so the only
+    surviving host copy of a secret operand is the returned ctypes array —
+    which callers wipe with _wipe_buf after the native call."""
+    step = limbs * _LIMB_BYTES
+    buf = bytearray(len(xs) * step)
+    for row, x in enumerate(xs):
+        buf[row * step : (row + 1) * step] = x.to_bytes(step, "little")
+    arr = (ctypes.c_uint64 * (len(xs) * limbs)).from_buffer_copy(buf)
+    buf[:] = bytes(len(buf))
+    return arr
+
+
+def _wipe_buf(*arrays) -> None:
+    """Zero ctypes limb buffers that held secret operands (exponents,
+    prime candidates, secret bases) once the native call returns — the
+    host-bridge leg of the zeroize discipline (SECURITY.md)."""
+    for a in arrays:
+        ctypes.memset(a, 0, ctypes.sizeof(a))
 
 
 def _from_buf(buf, rows: int, limbs: int) -> List[int]:
@@ -131,9 +146,10 @@ def modexp(base: int, exp: int, mod: int) -> int:
         return pow(base, exp, mod)
     EL = max(1, _limbs_for(exp))
     out = (ctypes.c_uint64 * L)()
-    rc = lib.fsdkr_modexp(
-        _to_buf([base % mod], L), _to_buf([exp], EL), _to_buf([mod], L), out, L, EL
-    )
+    base_buf = _to_buf([base % mod], L)
+    exp_buf = _to_buf([exp], EL)
+    rc = lib.fsdkr_modexp(base_buf, exp_buf, _to_buf([mod], L), out, L, EL)
+    _wipe_buf(base_buf, exp_buf)
     if rc != 0:
         return pow(base, exp, mod)
     return _from_buf(out, 1, L)[0]
@@ -160,15 +176,12 @@ def modexp_batch(
     EL = max(1, max(_limbs_for(e) for e in exps))
     rows = len(bases)
     out = (ctypes.c_uint64 * (rows * L))()
+    base_buf = _to_buf([b % m for b, m in zip(bases, mods)], L)
+    exp_buf = _to_buf(list(exps), EL)
     rc = lib.fsdkr_modexp_batch(
-        _to_buf([b % m for b, m in zip(bases, mods)], L),
-        _to_buf(list(exps), EL),
-        _to_buf(list(mods), L),
-        out,
-        rows,
-        L,
-        EL,
+        base_buf, exp_buf, _to_buf(list(mods), L), out, rows, L, EL
     )
+    _wipe_buf(base_buf, exp_buf)
     if rc != 0:
         return [pow(b, e, m) for b, e, m in zip(bases, exps, mods)]
     return _from_buf(out, rows, L)
@@ -183,9 +196,9 @@ def is_probable_prime(n: int, rounds: int = 30) -> Optional[bool]:
     if lib is None or L > _MAX_LIMBS or n < 5 or n % 2 == 0:
         return None
     witnesses = [2 + secrets.randbelow(n - 3) for _ in range(rounds)]
-    rc = lib.fsdkr_miller_rabin(
-        _to_buf([n], L), L, _to_buf(witnesses, L), rounds
-    )
+    n_buf = _to_buf([n], L)  # prime candidate: secret key material
+    rc = lib.fsdkr_miller_rabin(n_buf, L, _to_buf(witnesses, L), rounds)
+    _wipe_buf(n_buf)
     if rc < 0:
         return None
     return bool(rc)

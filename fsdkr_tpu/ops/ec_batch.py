@@ -42,7 +42,7 @@ from jax import lax
 from ..core.secp256k1 import N as CURVE_ORDER
 from ..core.secp256k1 import P as FIELD_P
 from ..core.secp256k1 import Point
-from .limbs import LIMB_BITS, LIMB_MASK, ints_to_limbs, limbs_to_ints
+from .limbs import LIMB_BITS, LIMB_MASK, ints_to_limbs, limbs_to_ints, wipe_array
 from .montgomery import _cond_subtract, _normalize_carries, mont_mul_limbs
 
 __all__ = ["batch_scalar_mul", "batch_msm", "points_to_device", "device_to_points"]
@@ -237,9 +237,13 @@ def device_to_points(arr) -> List[Point]:
     return out
 
 
-def _scalars_to_limbs(scalars: Sequence[int], scalar_bits: int) -> jnp.ndarray:
+def _scalars_to_limbs(scalars: Sequence[int], scalar_bits: int) -> np.ndarray:
+    """Returns the NUMPY staging array (not a device array): callers
+    upload it via jnp.asarray and wipe it with wipe_array once the
+    dependent results have materialized — EC scalars are key shares and
+    prover nonces (SECURITY.md)."""
     sl = -(-scalar_bits // LIMB_BITS)
-    return jnp.asarray(ints_to_limbs([s % CURVE_ORDER for s in scalars], sl))
+    return ints_to_limbs([s % CURVE_ORDER for s in scalars], sl)
 
 
 # ---------------------------------------------------------------------------
@@ -262,12 +266,15 @@ def batch_scalar_mul(
     pad = _pad_pow2(rows) - rows
     pts = list(points) + [Point.identity()] * pad
     scs = [s % CURVE_ORDER for s in scalars] + [0] * pad
+    sc_limbs = _scalars_to_limbs(scs, scalar_bits)
     out = _scalar_mul_kernel(
         points_to_device(pts),
-        _scalars_to_limbs(scs, scalar_bits),
+        jnp.asarray(sc_limbs),
         scalar_bits=scalar_bits,
     )
-    return device_to_points(out)[:rows]
+    res = device_to_points(out)[:rows]  # materializes the kernel output
+    wipe_array(sc_limbs)
+    return res
 
 
 def batch_generator_mul(scalars: Sequence[int]) -> List[Point]:
@@ -305,10 +312,13 @@ def batch_msm(
         pts.extend(list(gp) + [Point.identity()] * (m_pad - len(gp)))
         scs.extend([s % CURVE_ORDER for s in gs] + [0] * (m_pad - len(gs)))
 
+    sc_limbs = _scalars_to_limbs(scs, scalar_bits)
     prods = _scalar_mul_kernel(
         points_to_device(pts),
-        _scalars_to_limbs(scs, scalar_bits),
+        jnp.asarray(sc_limbs),
         scalar_bits=scalar_bits,
     )
     sums = _tree_sum_kernel(prods.reshape(g, m_pad, 3, _K))
-    return device_to_points(sums)
+    res = device_to_points(sums)  # materializes the kernel output
+    wipe_array(sc_limbs)
+    return res

@@ -24,6 +24,7 @@ __all__ = [
     "bucket_exp_bits",
     "ints_to_limbs",
     "limbs_to_ints",
+    "wipe_array",
     "MontgomeryContext",
 ]
 
@@ -61,6 +62,11 @@ def ints_to_limbs(xs: Sequence[int], num_limbs: int) -> np.ndarray:
 
     Via to_bytes + frombuffer: CPython serializes in C, so the host-side
     conversion cost is O(bytes) rather than a Python-level shift loop.
+
+    The staging bytearray is wiped in place before returning (astype
+    copies out of it), so the returned array is the ONLY host copy — call
+    wipe_array on it after device upload when the values are secret
+    (exponents, shares, nonces); see SECURITY.md.
     """
     nbytes = num_limbs * (LIMB_BITS // 8)
     buf = bytearray(len(xs) * nbytes)
@@ -73,9 +79,23 @@ def ints_to_limbs(xs: Sequence[int], num_limbs: int) -> np.ndarray:
             raise ValueError(
                 f"integer of {x.bit_length()} bits exceeds {num_limbs} limbs"
             ) from None
-    return np.frombuffer(bytes(buf), dtype="<u2").reshape(
-        len(xs), num_limbs
-    ).astype(np.uint32)
+    out = (
+        np.frombuffer(buf, dtype="<u2")
+        .reshape(len(xs), num_limbs)
+        .astype(np.uint32)
+    )
+    buf[:] = bytes(len(buf))  # wipe staging bytes
+    return out
+
+
+def wipe_array(*arrays) -> None:
+    """Zero numpy staging arrays that held secret limb material, once the
+    device computation consuming them has materialized its results (jax
+    may alias host numpy buffers on the CPU backend, so wiping is only
+    safe after the dependent outputs exist). No-op for None entries."""
+    for a in arrays:
+        if a is not None and isinstance(a, np.ndarray) and a.flags.writeable:
+            a.fill(0)
 
 
 def limbs_to_ints(arr) -> List[int]:

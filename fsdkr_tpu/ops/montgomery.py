@@ -42,6 +42,7 @@ from .limbs import (
     bucket_exp_bits,
     ints_to_limbs,
     limbs_to_ints,
+    wipe_array,
 )
 
 __all__ = [
@@ -393,13 +394,14 @@ class BatchModExp:
         bases = [b % n for b, n in zip(bases, self.ctx.moduli)]
         exp_bits = bucket_exp_bits(exps)
         exp_limbs = ints_to_limbs(exps, -(-exp_bits // LIMB_BITS))
+        base_limbs = ints_to_limbs(bases, k)
         mesh = self._mesh_for_rows(len(bases))
         if mesh is not None:
             from ..parallel.shard_kernels import sharded_modexp_fn
 
             kernel = sharded_modexp_fn(mesh, exp_bits)
             out = kernel(
-                jnp.asarray(ints_to_limbs(bases, k)),
+                jnp.asarray(base_limbs),
                 jnp.asarray(exp_limbs),
                 self._n,
                 self._n_prime,
@@ -408,7 +410,7 @@ class BatchModExp:
             )
         else:
             out = _modexp_kernel(
-                jnp.asarray(ints_to_limbs(bases, k)),
+                jnp.asarray(base_limbs),
                 jnp.asarray(exp_limbs),
                 self._n,
                 self._n_prime,
@@ -416,7 +418,11 @@ class BatchModExp:
                 self._one_mont,
                 exp_bits=exp_bits,
             )
-        return limbs_to_ints(np.asarray(out))
+        res = limbs_to_ints(np.asarray(out))
+        # exponents (and sometimes bases) are prover secrets; results have
+        # materialized above, so the staging copies can go (SECURITY.md)
+        wipe_array(exp_limbs, base_limbs)
+        return res
 
     def modmul(self, a: Sequence[int], b: Sequence[int]) -> List[int]:
         k = self.ctx.num_limbs
@@ -519,6 +525,7 @@ def shared_base_modexp(
             tree_chunk=_comb_tree_chunk(exp_bits // _WINDOW, g_cnt * m_max, num_limbs),
         )
     flat = limbs_to_ints(np.asarray(out).reshape(g_cnt * m_max, num_limbs))
+    wipe_array(exp_limbs)  # ring-Pedersen nonces etc.; results are out
     return [
         flat[g * m_max : g * m_max + len(exps_per_group[g])] for g in range(g_cnt)
     ]

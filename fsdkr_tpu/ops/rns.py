@@ -58,6 +58,7 @@ from .limbs import (
     bucket_exp_bits,
     ints_to_limbs,
     limbs_to_ints,
+    wipe_array,
 )
 from .montgomery import _normalize_carries
 
@@ -901,6 +902,7 @@ def rns_modexp_shared(
     ec = rb.exit_consts
     v_limbs = _crt_exit_kernel(out_res, *ec[:-1], k=k, lv=ec[-1])
     vs = limbs_to_ints(np.asarray(v_limbs))
+    wipe_array(exp_limbs)  # comb exponents are prover secrets
 
     out: List[List[int]] = []
     for r in range(g_cnt):
@@ -964,9 +966,13 @@ def rns_modexp(
             n_bmr[r, :k] = [3 % b for b in rb.B_primes]
             n_bmr[r, k] = 3 % rb.m_r
 
+    base_limbs = ints_to_limbs(
+        [b % n for b, n in zip(bases_int, moduli)], num_limbs
+    )
+    exp_limbs = ints_to_limbs(list(exps), el)
     args = (
-        jnp.asarray(ints_to_limbs([b % n for b, n in zip(bases_int, moduli)], num_limbs)),
-        jnp.asarray(ints_to_limbs(list(exps), el)),
+        jnp.asarray(base_limbs),
+        jnp.asarray(exp_limbs),
         jnp.asarray(ints_to_limbs(a2n, num_limbs)),
         jnp.asarray(c1),
         jnp.asarray(n_bmr),
@@ -988,6 +994,7 @@ def rns_modexp(
     ec = rb.exit_consts
     v_limbs = _crt_exit_kernel(out_res, *ec[:-1], k=k, lv=ec[-1])
     vs = limbs_to_ints(np.asarray(v_limbs))
+    wipe_array(exp_limbs, base_limbs)  # secret exponents/bases; vs is out
     out = []
     for r in range(rows):
         if r in fallback_rows:

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""EC device-vs-host A/B: measures the crossover of the batched device EC
+path (`fsdkr_tpu.ops.ec_batch`) against the host Jacobian oracle
+(`fsdkr_tpu.core.secp256k1`) on the shapes collect()/distribute() actually
+launch (VERDICT r4 item 3 — the EC columns displace the reference's serial
+point math at `src/zk_pdl_with_slack.rs:124-127` and
+`src/refresh_message.rs:177-188`).
+
+Shapes measured, per committee size n (t = n/2):
+- genmul:   s_i * G fan-out, rows = n^2       (distribute.commit_points)
+- u1msm:    ONE group of 2*n^2+1 points, the random-linear-combination
+            combined check                     (pdl.ec_u1)
+- u1host:   the per-row host equivalent (2 muls/row) it replaces
+- feldman:  n groups of (n + t + 1) points     (collect.validate_feldman)
+- feldhost: host validate_share_public on the same rows
+
+Each device measurement runs twice: first includes compile, second is the
+warm number. Prints one JSON object per (shape, n) to stdout and a summary
+table to stderr. BENCH_EC_NS overrides the committee sizes (comma list).
+
+Usage:  [BENCH_PLATFORM=cpu] python scripts/bench_ec.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    import jax
+
+    platform = jax.devices()[0].platform
+    log(f"platform: {platform}")
+
+    import secrets
+
+    from fsdkr_tpu.core.secp256k1 import GENERATOR, N, Point, Scalar
+    from fsdkr_tpu.core.vss import ShamirSecretSharing, VerifiableSS
+    from fsdkr_tpu.ops.ec_batch import batch_msm, batch_scalar_mul
+
+    ns = [int(x) for x in os.environ.get("BENCH_EC_NS", "16,64,256").split(",")]
+    results = []
+
+    def emit(shape, n, rows, host_s, dev_cold, dev_warm):
+        rec = {
+            "shape": shape,
+            "n": n,
+            "rows": rows,
+            "platform": platform,
+            "host_s": round(host_s, 3) if host_s is not None else None,
+            "device_cold_s": round(dev_cold, 3),
+            "device_warm_s": round(dev_warm, 3),
+            "device_speedup_warm": (
+                round(host_s / dev_warm, 3) if host_s else None
+            ),
+        }
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    for n in ns:
+        t = n // 2
+        rows = n * n
+
+        # --- genmul: s*G fan-out ---------------------------------------
+        scalars = [secrets.randbelow(N) for _ in range(rows)]
+        t0 = time.time()
+        host_pts = [GENERATOR * Scalar.from_int(s) for s in scalars]
+        host_s = time.time() - t0
+        t0 = time.time()
+        dev_pts = batch_scalar_mul([GENERATOR] * rows, scalars)
+        cold = time.time() - t0
+        t0 = time.time()
+        dev_pts = batch_scalar_mul([GENERATOR] * rows, scalars)
+        warm = time.time() - t0
+        assert dev_pts == host_pts, f"genmul mismatch at n={n}"
+        emit("genmul", n, rows, host_s, cold, warm)
+        log(f"n={n} genmul: host {host_s:.2f}s dev {warm:.2f}s")
+
+        # --- u1: combined RLC check vs per-row host --------------------
+        # device: one group of 2*rows+1 points, 256-bit scalars
+        pts = host_pts[:rows] + host_pts[:rows] + [GENERATOR]
+        scs = [secrets.randbelow(N) for _ in range(2 * rows + 1)]
+        t0 = time.time()
+        (comb,) = batch_msm([pts], [scs])
+        cold = time.time() - t0
+        t0 = time.time()
+        (comb2,) = batch_msm([pts], [scs])
+        warm = time.time() - t0
+        assert comb == comb2
+        # host equivalent: 2 scalar muls + 1 add per row
+        sample = min(rows, 512)
+        t0 = time.time()
+        for i in range(sample):
+            _ = host_pts[i] * Scalar.from_int(scs[i]) + host_pts[i] * Scalar.from_int(scs[rows + i])
+        host_s = (time.time() - t0) / sample * rows
+        emit("u1msm", n, 2 * rows + 1, host_s, cold, warm)
+        log(f"n={n} u1: host(2muls/row, extrap) {host_s:.2f}s dev-msm {warm:.2f}s")
+
+        # --- feldman: n groups of (n + t + 1) --------------------------
+        params = ShamirSecretSharing(t, n)
+        scheme = VerifiableSS(
+            params, [GENERATOR * Scalar.from_int(i + 2) for i in range(t + 1)]
+        )
+        share_pts = [host_pts[i] for i in range(n)]
+        groups_pts, groups_scs = [], []
+        for _ in range(n):
+            rho = [secrets.randbits(128) for _ in range(n)]
+            c_vec = [secrets.randbelow(N) for _ in range(t + 1)]
+            groups_pts.append(share_pts + list(scheme.commitments))
+            groups_scs.append(rho + c_vec)
+        t0 = time.time()
+        dev_out = batch_msm(groups_pts, groups_scs)
+        cold = time.time() - t0
+        t0 = time.time()
+        dev_out2 = batch_msm(groups_pts, groups_scs)
+        warm = time.time() - t0
+        assert dev_out == dev_out2
+        # host equivalent: validate_share_public per (msg, i) row
+        sample = min(n * n, 256)
+        done = 0
+        t0 = time.time()
+        for _ in range(n):
+            for i in range(n):
+                if done >= sample:
+                    break
+                scheme.validate_share_public(share_pts[i], i + 1)
+                done += 1
+        host_s = (time.time() - t0) / done * n * n
+        emit("feldman", n, n * n, host_s, cold, warm)
+        log(f"n={n} feldman: host(extrap) {host_s:.2f}s dev {warm:.2f}s")
+
+    log("summary:")
+    for r in results:
+        log(
+            f"  {r['shape']:8s} n={r['n']:<4d} host {r['host_s']}s "
+            f"dev {r['device_warm_s']}s speedup {r['device_speedup_warm']}"
+        )
+
+
+if __name__ == "__main__":
+    main()

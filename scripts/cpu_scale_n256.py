@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Config-4-at-reduced-parameters structural run: collect() at n=256,
+t=128 end-to-end on whatever platform JAX has (VERDICT r4 item 2 — the
+first execution of the north-star shape anywhere; reference loop
+`/root/reference/src/refresh_message.rs:321-467`).
+
+Reduced parameters (768-bit moduli, M=32, 3 correct-key rounds) keep the
+single-core wall-clock in hours instead of days while exercising exactly
+what the item asks: the 131,072-row pair gather, the per-family fused
+launches, shape bucketing, and the memory plan at n=256. The series is
+comparable to bench_results/cpu_scale_n64.json (same parameters, n=64).
+
+One collect (not cold+warm): on the fallback platform the point is
+structural proof, not steady-state throughput; the trace splits compile
+from compute via the persistent cache delta. A small host-baseline
+subsample (HOST_PAIRS rows) gives the extrapolated vs_baseline.
+
+Writes ONE JSON line to stdout; progress to stderr.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    n = int(os.environ.get("BENCH_N", "256"))
+    t = int(os.environ.get("BENCH_T", str(n // 2)))
+    bits = int(os.environ.get("BENCH_BITS", "768"))
+    m_sec = int(os.environ.get("BENCH_M", "32"))
+    ck_rounds = int(os.environ.get("BENCH_CK", "3"))
+    host_pairs = int(os.environ.get("HOST_PAIRS", "128"))
+
+    plat = os.environ.get("BENCH_PLATFORM", "cpu")
+    import jax
+
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:
+        pass
+    platform = jax.devices()[0].platform
+    log(f"platform: {platform} n={n} t={t} bits={bits} M={m_sec}")
+
+    os.environ.setdefault("FSDKR_TRACE", "1")
+    from fsdkr_tpu.config import ProtocolConfig
+    from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+    from fsdkr_tpu.utils.trace import get_tracer
+
+    cfg = ProtocolConfig(
+        paillier_bits=bits, m_security=m_sec, correct_key_rounds=ck_rounds
+    )
+    tpu_cfg = cfg.with_backend("tpu")
+
+    t0 = time.time()
+    keys = simulate_keygen(t, n, cfg)
+    t_keygen = time.time() - t0
+    log(f"keygen: {t_keygen:.1f}s")
+
+    get_tracer().reset()
+    t0 = time.time()
+    results = RefreshMessage.distribute_batch(
+        [(key.i, key) for key in keys], n, tpu_cfg
+    )
+    t_distribute = time.time() - t0
+    msgs = [m for m, _ in results]
+    dks = [dk for _, dk in results]
+    dist_stats = get_tracer().stats()
+    trace_distribute = {
+        name: round(st.seconds, 3)
+        for name, st in dist_stats.items()
+        if name.startswith("distribute.")
+    }
+    log(f"distribute_batch: {t_distribute:.1f}s {trace_distribute}")
+
+    cache_before = len(os.listdir(cache_dir)) if os.path.isdir(cache_dir) else 0
+    get_tracer().reset()
+    t0 = time.time()
+    RefreshMessage.collect(msgs, keys[0].clone(), dks[0], (), tpu_cfg)
+    t_collect = time.time() - t0
+    cache_after = len(os.listdir(cache_dir)) if os.path.isdir(cache_dir) else 0
+    stats = get_tracer().stats()
+    trace = {name: round(st.seconds, 3) for name, st in stats.items()}
+    proofs = 2 * n * n + 2 * n
+    log(
+        f"collect: {t_collect:.1f}s -> {proofs / t_collect:.1f} proofs/s "
+        f"({cache_after - cache_before} fresh compiles)"
+    )
+    log(get_tracer().report())
+
+    # host baseline on a small subsample of the pair loop
+    from fsdkr_tpu.backend.batch_verifier import HostBatchVerifier
+    from fsdkr_tpu.core.secp256k1 import GENERATOR
+    from fsdkr_tpu.proofs.pdl_slack import PDLwSlackStatement
+
+    host = HostBatchVerifier(cfg.hash_alg)
+    key = keys[1]
+    pdl_items, range_items = [], []
+    for msg in msgs:
+        for i in range(n):
+            if len(pdl_items) >= host_pairs:
+                break
+            st = PDLwSlackStatement(
+                ciphertext=msg.points_encrypted_vec[i],
+                ek=key.paillier_key_vec[i],
+                Q=msg.points_committed_vec[i],
+                G=GENERATOR,
+                h1=key.h1_h2_n_tilde_vec[i].g,
+                h2=key.h1_h2_n_tilde_vec[i].ni,
+                N_tilde=key.h1_h2_n_tilde_vec[i].N,
+            )
+            pdl_items.append((msg.pdl_proof_vec[i], st))
+            range_items.append(
+                (
+                    msg.range_proofs[i],
+                    msg.points_encrypted_vec[i],
+                    key.paillier_key_vec[i],
+                    key.h1_h2_n_tilde_vec[i],
+                )
+            )
+        if len(pdl_items) >= host_pairs:
+            break
+    t0 = time.time()
+    ok_pdl = all(v is None for v in host.verify_pdl(pdl_items))
+    ok_rng = all(host.verify_range(range_items))
+    per_pair = (time.time() - t0) / len(pdl_items)
+    if not (ok_pdl and ok_rng):
+        raise RuntimeError("host baseline rejected a valid proof")
+    t_host = n * n * per_pair  # pair loop only (dominant term)
+    log(
+        f"host baseline: {per_pair * 1e3:.1f} ms/pair -> ~{t_host:.0f}s "
+        f"extrapolated pair loop"
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": f"collect() @ n={n},t={t},{bits}-bit,M={m_sec} "
+                f"[structural, {platform}]",
+                "value": round(proofs / t_collect, 2),
+                "unit": "proofs/s",
+                "vs_baseline": round(t_host / t_collect, 2),
+                "collect_s": round(t_collect, 2),
+                "distribute_batch_s": round(t_distribute, 2),
+                "keygen_s": round(t_keygen, 2),
+                "fresh_compiles": cache_after - cache_before,
+                "host_pair_ms": round(per_pair * 1e3, 2),
+                "platform": platform,
+                "trace": trace,
+                "trace_distribute": trace_distribute,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

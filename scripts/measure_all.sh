@@ -51,9 +51,14 @@ run n128 6000 BENCH_N=128 BENCH_T=64 FSDKR_TRACE=1 python bench.py
 run n256 9000 BENCH_N=256 BENCH_T=128 FSDKR_TRACE=1 python bench.py
 # kernel-level sweep (sets router thresholds; experimental points last)
 run sweep_quick 3600 python scripts/bench_kernels.py quick
+# EC device-vs-host crossover on the real chip (routes config.device_ec;
+# the CPU-platform points are bench_results/ec_ab_cpu.json)
+run ec_ab 4800 BENCH_EC_NS=16,64,256 python scripts/bench_ec.py
 # fallback datapoint if the RNS path misbehaves on the real chip —
 # also disables tree-comb, i.e. exactly the round-2 known-good kernels
 run n16_cios 2400 FSDKR_RNS_MIN_ROWS=999999999 FSDKR_COMB_TREE=0 FSDKR_TRACE=1 python bench.py
 # and the inverse A/B: RNS everywhere but sequential comb ladders
 run n16_notree 2400 FSDKR_COMB_TREE=0 FSDKR_TRACE=1 python bench.py
+# forced-host-EC A/B of a full collect at n=64 (isolates the EC columns)
+run n64_hostec 3600 BENCH_N=64 BENCH_T=32 FSDKR_DEVICE_EC=0 FSDKR_TRACE=1 python bench.py
 echo "=== battery done ==="

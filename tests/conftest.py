@@ -22,6 +22,11 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
+# The suite runs on the CPU platform, where auto EC routing would send
+# every EC path to the host oracle (fsdkr_tpu.config.device_ec) — force
+# the device route so the batched EC kernels keep integration coverage.
+os.environ.setdefault("FSDKR_DEVICE_EC", "1")
+
 import pytest  # noqa: E402
 
 from fsdkr_tpu.config import TEST_CONFIG  # noqa: E402
