@@ -186,6 +186,10 @@ int fsdkr_modexp(const u64 *base, const u64 *exp, const u64 *n, u64 *out,
     secure_wipe(b, L);
     secure_wipe(base_m, L);
     secure_wipe(&table[0][0], 16 * MAXL);
+    // one_m/r2 reconstruct the modulus (secret on the Paillier-decrypt
+    // path where n = p^2): gcd(R - one_m, R^2 - r2) recovers it
+    secure_wipe(one_m, L);
+    secure_wipe(r2, L);
     return 0;
   }
 
@@ -208,6 +212,8 @@ int fsdkr_modexp(const u64 *base, const u64 *exp, const u64 *n, u64 *out,
   secure_wipe(base_m, L);
   secure_wipe(&table[0][0], 16 * MAXL);
   secure_wipe(acc, L);
+  secure_wipe(one_m, L); // see exp==0 branch: these reconstruct n
+  secure_wipe(r2, L);
   return 0;
 }
 

@@ -148,11 +148,16 @@ def modexp(base: int, exp: int, mod: int) -> int:
     out = (ctypes.c_uint64 * L)()
     base_buf = _to_buf([base % mod], L)
     exp_buf = _to_buf([exp], EL)
-    rc = lib.fsdkr_modexp(base_buf, exp_buf, _to_buf([mod], L), out, L, EL)
-    _wipe_buf(base_buf, exp_buf)
+    # the modulus and result are secret too on the Paillier-decrypt path
+    # (mod = p^2; gcd(out - 1, N) = p), so all four buffers are wiped
+    mod_buf = _to_buf([mod], L)
+    rc = lib.fsdkr_modexp(base_buf, exp_buf, mod_buf, out, L, EL)
     if rc != 0:
+        _wipe_buf(base_buf, exp_buf, mod_buf)
         return pow(base, exp, mod)
-    return _from_buf(out, 1, L)[0]
+    res = _from_buf(out, 1, L)[0]
+    _wipe_buf(base_buf, exp_buf, mod_buf, out)
+    return res
 
 
 def modexp_batch(
@@ -178,13 +183,14 @@ def modexp_batch(
     out = (ctypes.c_uint64 * (rows * L))()
     base_buf = _to_buf([b % m for b, m in zip(bases, mods)], L)
     exp_buf = _to_buf(list(exps), EL)
-    rc = lib.fsdkr_modexp_batch(
-        base_buf, exp_buf, _to_buf(list(mods), L), out, rows, L, EL
-    )
-    _wipe_buf(base_buf, exp_buf)
+    mod_buf = _to_buf(list(mods), L)
+    rc = lib.fsdkr_modexp_batch(base_buf, exp_buf, mod_buf, out, rows, L, EL)
     if rc != 0:
+        _wipe_buf(base_buf, exp_buf, mod_buf)
         return [pow(b, e, m) for b, e, m in zip(bases, exps, mods)]
-    return _from_buf(out, rows, L)
+    res = _from_buf(out, rows, L)
+    _wipe_buf(base_buf, exp_buf, mod_buf, out)
+    return res
 
 
 def is_probable_prime(n: int, rounds: int = 30) -> Optional[bool]:

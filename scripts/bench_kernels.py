@@ -189,7 +189,9 @@ def main():
     import jax
 
     try:
-        jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+        from bench import _jax_cache_dir
+
+        jax.config.update("jax_compilation_cache_dir", _jax_cache_dir())
     except Exception:
         pass
     log(f"devices: {jax.devices()}  backend: {jax.default_backend()}")

@@ -43,9 +43,9 @@ def main():
 
     if plat:
         jax.config.update("jax_platforms", plat)
-    cache_dir = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
-    )
+    from bench import _jax_cache_dir  # single source for the cache path
+
+    cache_dir = _jax_cache_dir()
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
     except Exception:
