@@ -130,10 +130,15 @@ def _wipe_buf(*arrays) -> None:
 
 
 def _from_buf(buf, rows: int, limbs: int) -> List[int]:
-    raw = bytes(buf)
+    """Read results without an immutable `bytes` copy: int.from_bytes
+    accepts memoryview slices directly, so the only surviving host copies
+    of a secret result are the returned Python ints (a documented
+    residual — see SECURITY.md) and `buf` itself, which callers wipe."""
+    mv = memoryview(buf).cast("B")
     step = limbs * _LIMB_BYTES
     return [
-        int.from_bytes(raw[i * step : (i + 1) * step], "little") for i in range(rows)
+        int.from_bytes(mv[i * step : (i + 1) * step], "little")
+        for i in range(rows)
     ]
 
 
@@ -186,7 +191,8 @@ def modexp_batch(
     mod_buf = _to_buf(list(mods), L)
     rc = lib.fsdkr_modexp_batch(base_buf, exp_buf, mod_buf, out, rows, L, EL)
     if rc != 0:
-        _wipe_buf(base_buf, exp_buf, mod_buf)
+        # rows before the failing one have already written results
+        _wipe_buf(base_buf, exp_buf, mod_buf, out)
         return [pow(b, e, m) for b, e, m in zip(bases, exps, mods)]
     res = _from_buf(out, rows, L)
     _wipe_buf(base_buf, exp_buf, mod_buf, out)

@@ -126,6 +126,16 @@ class JoinMessage:
         cipher_sum, li_vec = RefreshMessage.get_ciphertext_sum(
             refresh_messages, party_index, parameters, paillier_key.ek
         )
+        # same Lagrange-weight hardening as refresh collect: the
+        # interpolated Feldman constant terms must re-derive the group
+        # key every sender broadcast (all-equal gated below)
+        if (
+            RefreshMessage.interpolate_constant_term(refresh_messages, li_vec, t)
+            != refresh_messages[0].public_key
+        ):
+            from ..errors import PublicShareValidationError
+
+            raise PublicShareValidationError()
         new_share = paillier.decrypt(paillier_key.dk, paillier_key.ek, cipher_sum)
         new_share_fe = Scalar.from_int(new_share)
 

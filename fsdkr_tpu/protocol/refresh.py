@@ -369,6 +369,28 @@ class RefreshMessage:
 
     # ------------------------------------------------------------------
     @staticmethod
+    def interpolate_constant_term(
+        refresh_messages: Sequence["RefreshMessage"],
+        li_vec: Sequence[Scalar],
+        t: int,
+    ) -> Point:
+        """sum_j lambda_j * A_0^{(j)} over the first t+1 senders' Feldman
+        constant-term commitments. Each A_0^{(j)} commits to sender j's
+        OLD share x_j, so with honest Lagrange weights this re-derives
+        the (unchanged) group public key — the hardening gate both
+        collect paths compare against y (reference quirk 4 / TODO at
+        src/refresh_message.rs:199 leaves the broadcast old_party_index
+        untrusted-but-unchecked)."""
+        acc = refresh_messages[0].coefficients_committed_vec.commitments[0] * li_vec[0]
+        for j in range(1, t + 1):
+            acc = acc + (
+                refresh_messages[j].coefficients_committed_vec.commitments[0]
+                * li_vec[j]
+            )
+        return acc
+
+    # ------------------------------------------------------------------
+    @staticmethod
     def replace(
         new_parties: Sequence["JoinMessage"],
         key: LocalKey,
@@ -610,6 +632,16 @@ class RefreshMessage:
                 cipher_sum, li_vec = RefreshMessage.get_ciphertext_sum(
                     msgs, key.i, key.vss_scheme.parameters, old_ek
                 )
+                # Hardening absent from the reference: the Lagrange
+                # weights must re-derive the unchanged group key, or a
+                # lying/duplicated old_party_index silently rotates the
+                # committee onto a DIFFERENT secret (see
+                # interpolate_constant_term).
+                y_check = RefreshMessage.interpolate_constant_term(
+                    msgs, li_vec, key.t
+                )
+                if y_check != key.y_sum_s:
+                    raise PublicShareValidationError()
                 sums[s] = (old_ek, cipher_sum, li_vec)
             except Exception as e:
                 errors[s] = e

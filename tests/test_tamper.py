@@ -1,0 +1,134 @@
+"""Adversarial tamper matrix: every broadcast field of RefreshMessage is
+perturbed post-distribute and collect() must reject with the matching
+identifiable-abort error.
+
+Generalizes the reference's single soundness negative
+(`/root/reference/src/zk_pdl_with_slack.rs:268-331`, which encrypts x+1
+and expects verification failure) to the full wire surface of
+`RefreshMessage` (`src/refresh_message.rs:31-48`) — a malicious rushing
+adversary controls every byte it broadcasts (`src/lib.rs:5-9`)."""
+
+import copy
+import dataclasses
+
+import pytest
+
+from fsdkr_tpu.core.secp256k1 import GENERATOR
+from fsdkr_tpu.errors import (
+    BroadcastedPublicKeyError,
+    ModuliTooSmall,
+    PaillierVerificationError,
+    PartiesThresholdViolation,
+    PDLwSlackProofError,
+    PublicShareValidationError,
+    RangeProofError,
+    RingPedersenProofError,
+    SizeMismatchError,
+)
+from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+
+
+@pytest.fixture(scope="module")
+def refreshed(test_config):
+    """One honest refresh round: keys (post-distribute), messages, dks."""
+    keys = simulate_keygen(1, 3, test_config)
+    out = [RefreshMessage.distribute(k.i, k, 3, test_config) for k in keys]
+    return keys, [m for m, _ in out], [dk for _, dk in out]
+
+
+def _collect_tampered(refreshed, test_config, mutate, collector=0):
+    keys, msgs, dks = refreshed
+    msgs = copy.deepcopy(msgs)
+    mutate(msgs)
+    key = keys[collector].clone()
+    RefreshMessage.collect(msgs, key, dks[collector], (), test_config)
+
+
+CASES = [
+    # (name, expected error, mutation)
+    (
+        "public_key",
+        BroadcastedPublicKeyError,
+        lambda msgs: setattr(msgs[1], "public_key", msgs[1].public_key + GENERATOR),
+    ),
+    (
+        "committed_point",
+        PublicShareValidationError,  # Feldman check
+        lambda msgs: msgs[1].points_committed_vec.__setitem__(
+            0, msgs[1].points_committed_vec[0] + GENERATOR
+        ),
+    ),
+    (
+        "pdl_proof_s1",
+        PDLwSlackProofError,
+        lambda msgs: msgs[1].pdl_proof_vec.__setitem__(
+            0, dataclasses.replace(msgs[1].pdl_proof_vec[0], s1=msgs[1].pdl_proof_vec[0].s1 + 1)
+        ),
+    ),
+    (
+        "range_proof_s",
+        RangeProofError,
+        lambda msgs: msgs[1].range_proofs.__setitem__(
+            0, dataclasses.replace(msgs[1].range_proofs[0], s=msgs[1].range_proofs[0].s + 1)
+        ),
+    ),
+    (
+        "ring_pedersen_Z",
+        RingPedersenProofError,
+        lambda msgs: msgs[1].ring_pedersen_proof.Z.__setitem__(
+            0, msgs[1].ring_pedersen_proof.Z[0] + 1
+        ),
+    ),
+    (
+        "correct_key_sigma",
+        PaillierVerificationError,
+        lambda msgs: msgs[1].dk_correctness_proof.sigma_vec.__setitem__(
+            0, msgs[1].dk_correctness_proof.sigma_vec[0] + 1
+        ),
+    ),
+    (
+        "new_ek_too_small",
+        (PaillierVerificationError, ModuliTooSmall),
+        lambda msgs: setattr(
+            msgs[1], "ek", type(msgs[1].ek).from_n((1 << 520) + 21)
+        ),
+    ),
+    (
+        "ciphertext",
+        PDLwSlackProofError,  # the PDL statement binds the ciphertext
+        lambda msgs: msgs[1].points_encrypted_vec.__setitem__(
+            0, msgs[1].points_encrypted_vec[0] + 1
+        ),
+    ),
+    (
+        "lagrange_index",
+        PublicShareValidationError,  # constant-term interpolation gate:
+        # a lying old_party_index skews the Lagrange weights and would
+        # silently rotate onto a different secret (reference quirk 4
+        # leaves this undetected)
+        lambda msgs: setattr(msgs[0], "old_party_index", msgs[1].old_party_index),
+    ),
+    (
+        "short_vector",
+        SizeMismatchError,
+        lambda msgs: msgs[1].points_encrypted_vec.pop(),
+    ),
+]
+
+
+@pytest.mark.parametrize("name,err,mutate", CASES, ids=[c[0] for c in CASES])
+def test_tampered_broadcast_rejected(refreshed, test_config, name, err, mutate):
+    with pytest.raises(err):
+        _collect_tampered(refreshed, test_config, mutate)
+
+
+def test_too_few_messages(refreshed, test_config):
+    keys, msgs, dks = refreshed
+    with pytest.raises(PartiesThresholdViolation):
+        RefreshMessage.collect(msgs[:1], keys[0].clone(), dks[0], (), test_config)
+
+
+def test_honest_baseline_still_accepts(refreshed, test_config):
+    """The fixture's messages are genuinely valid — the matrix fails for
+    the tamper, not because the fixture is broken."""
+    _collect_tampered(refreshed, test_config, lambda msgs: None, collector=2)
