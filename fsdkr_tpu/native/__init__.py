@@ -158,7 +158,7 @@ def modexp(base: int, exp: int, mod: int) -> int:
     mod_buf = _to_buf([mod], L)
     rc = lib.fsdkr_modexp(base_buf, exp_buf, mod_buf, out, L, EL)
     if rc != 0:
-        _wipe_buf(base_buf, exp_buf, mod_buf)
+        _wipe_buf(base_buf, exp_buf, mod_buf, out)
         return pow(base, exp, mod)
     res = _from_buf(out, 1, L)[0]
     _wipe_buf(base_buf, exp_buf, mod_buf, out)

@@ -26,6 +26,7 @@ from ..core.secp256k1 import GENERATOR, Scalar
 from ..errors import (
     BroadcastedPublicKeyError,
     NewPartyUnassignedIndexError,
+    PublicShareValidationError,
     RingPedersenProofValidation,
 )
 from ..backend import get_backend
@@ -133,8 +134,6 @@ class JoinMessage:
             RefreshMessage.interpolate_constant_term(refresh_messages, li_vec, t)
             != refresh_messages[0].public_key
         ):
-            from ..errors import PublicShareValidationError
-
             raise PublicShareValidationError()
         new_share = paillier.decrypt(paillier_key.dk, paillier_key.ek, cipher_sum)
         new_share_fe = Scalar.from_int(new_share)
@@ -151,8 +150,6 @@ class JoinMessage:
         # same consistency gate as refresh collect: the decrypted share must
         # match the committed public share
         if keys_linear.y != pk_vec[party_index - 1]:
-            from ..errors import PublicShareValidationError
-
             raise PublicShareValidationError()
 
         available_eks = {m.party_index: m.ek for m in refresh_messages}

@@ -36,12 +36,12 @@ def refreshed(test_config):
     return keys, [m for m, _ in out], [dk for _, dk in out]
 
 
-def _collect_tampered(refreshed, test_config, mutate, collector=0):
+def _collect_tampered(refreshed, config, mutate, collector=0):
     keys, msgs, dks = refreshed
     msgs = copy.deepcopy(msgs)
     mutate(msgs)
     key = keys[collector].clone()
-    RefreshMessage.collect(msgs, key, dks[collector], (), test_config)
+    RefreshMessage.collect(msgs, key, dks[collector], (), config)
 
 
 CASES = [
@@ -116,10 +116,24 @@ CASES = [
 ]
 
 
+@pytest.mark.parametrize(
+    "backend",
+    [
+        "host",
+        # batched-backend collects on the CPU platform cost ~30 s each:
+        # keep the smoke gate under 3 minutes (scripts/ci.sh)
+        pytest.param("tpu", marks=pytest.mark.heavy),
+    ],
+)
 @pytest.mark.parametrize("name,err,mutate", CASES, ids=[c[0] for c in CASES])
-def test_tampered_broadcast_rejected(refreshed, test_config, name, err, mutate):
+def test_tampered_broadcast_rejected(
+    refreshed, test_config, backend, name, err, mutate
+):
+    """Both verification backends must reject every tamper with the same
+    identifiable-abort error — the TPU backend's batched launches and
+    loop-order attribution are the production path."""
     with pytest.raises(err):
-        _collect_tampered(refreshed, test_config, mutate)
+        _collect_tampered(refreshed, test_config.with_backend(backend), mutate)
 
 
 def test_too_few_messages(refreshed, test_config):
