@@ -18,10 +18,15 @@ subsample (HOST_PAIRS rows) gives the extrapolated vs_baseline.
 Writes ONE JSON line to stdout; progress to stderr.
 """
 
+import faulthandler
 import json
 import os
 import sys
 import time
+
+# a fatal signal (e.g. SIGILL from a stale cross-machine XLA AOT cache
+# entry — the silent death mode of the first attempt) must leave a trace
+faulthandler.enable(file=sys.stderr)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -31,6 +36,17 @@ def log(msg):
 
 
 def main():
+    # self-written pidfile: `$!` after `setsid nohup ... &` records the
+    # short-lived wrapper, not this process (see memory: box-quirks)
+    _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        with open(
+            os.path.join(_repo, "bench_results", ".cpu_scale.pid"), "w"
+        ) as f:
+            f.write(str(os.getpid()))
+    except OSError:
+        pass
+
     n = int(os.environ.get("BENCH_N", "256"))
     t = int(os.environ.get("BENCH_T", str(n // 2)))
     bits = int(os.environ.get("BENCH_BITS", "768"))
@@ -86,6 +102,7 @@ def main():
 
     cache_before = len(os.listdir(cache_dir)) if os.path.isdir(cache_dir) else 0
     get_tracer().reset()
+    log("starting collect ...")
     t0 = time.time()
     RefreshMessage.collect(msgs, keys[0].clone(), dks[0], (), tpu_cfg)
     t_collect = time.time() - t0

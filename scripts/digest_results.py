@@ -30,12 +30,14 @@ def load(path):
 
 def main():
     root = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else "bench_results")
-    configs, kernels, traces = [], [], {}
+    configs, kernels, traces, ec_ab = [], [], {}, []
     for path in sorted(root.glob("m_*.json")):
         name = path.stem[2:]
         for rec in load(path):
             if "kernel" in rec:
                 kernels.append(rec)
+            elif "shape" in rec:  # scripts/bench_ec.py A/B records
+                ec_ab.append(rec)
             elif "metric" in rec:
                 configs.append((name, rec))
                 if rec.get("trace"):
@@ -83,6 +85,18 @@ def main():
             print(
                 f"| {r['kernel']} | {r['bits']} | {r['exp_bits']} | {r['rows']} "
                 f"| {r.get('groups', '—')} | {r['seconds']} | {r['modexp_per_s']} |"
+            )
+        print()
+
+    if ec_ab:
+        print("### EC device-vs-host A/B (scripts/bench_ec.py)\n")
+        print("| shape | n | rows | platform | host s | device warm s | device speedup |")
+        print("|---|---|---|---|---|---|---|")
+        for r in ec_ab:
+            print(
+                f"| {r['shape']} | {r['n']} | {r['rows']} | {r['platform']} "
+                f"| {r.get('host_s', '—')} | {r.get('device_warm_s', '—')} "
+                f"| {r.get('device_speedup_warm', '—')}x |"
             )
         print()
 
