@@ -37,7 +37,21 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+_PLATFORM = None  # set by init_jax_with_retry on successful backend init
+
+
 def emit(result):
+    # every line self-describes where it was measured; fallback runs are
+    # tagged so a CPU-platform number can never read as a chip number.
+    # NEVER touch jax here: on the fail-hard error path the backend was
+    # never initialized and an in-process jax.devices() on a dead tunnel
+    # hangs without printing the guaranteed JSON line (the round-1
+    # failure mode the out-of-process probe exists to avoid).
+    if _PLATFORM:
+        result.setdefault("platform", _PLATFORM)
+    note = os.environ.get("BENCH_FALLBACK_NOTE")
+    if note:
+        result.setdefault("fallback_note", note)
     print(json.dumps(result), flush=True)
 
 
@@ -89,9 +103,29 @@ def init_jax_with_retry(attempts=4, delay=15.0):
             if i + 1 < attempts:
                 time.sleep(delay)
         else:
-            raise RuntimeError(
-                f"TPU backend unreachable after {attempts} probes"
+            # Degrade to an honest CPU-platform measurement instead of a
+            # zero datapoint (rounds 3 and 4 both recorded 0 proofs/s
+            # through multi-hour tunnel outages). The emitted metric is
+            # tagged with the platform and a fallback note, and the
+            # workload shrinks to fallback-sized parameters unless the
+            # caller pinned them (BENCH_CPU_FALLBACK=0 restores the old
+            # fail-hard behavior).
+            if os.environ.get("BENCH_CPU_FALLBACK", "1") != "1":
+                raise RuntimeError(
+                    f"TPU backend unreachable after {attempts} probes"
+                )
+            log("tunnel down: falling back to the CPU platform")
+            plat = "cpu"
+            os.environ["BENCH_PLATFORM"] = "cpu"
+            os.environ["BENCH_FALLBACK_NOTE"] = (
+                f"TPU tunnel unreachable after {attempts} probes; measured "
+                "on the XLA:CPU fallback platform (structural datapoint, "
+                "not a chip number)"
             )
+            os.environ.setdefault("BENCH_N", "8")
+            os.environ.setdefault("BENCH_T", "4")
+            os.environ.setdefault("BENCH_BITS", "768")
+            os.environ.setdefault("BENCH_M", "32")
 
     import jax
 
@@ -116,6 +150,8 @@ def init_jax_with_retry(attempts=4, delay=15.0):
         try:
             devs = jax.devices()
             log(f"devices: {devs}")
+            global _PLATFORM
+            _PLATFORM = devs[0].platform
             return jax, devs
         except Exception as e:  # backend init failure is retriable
             last = e
@@ -322,14 +358,16 @@ def bench_join(n, t, bits, m_sec, joins):
 
 
 def main():
+    jax, _ = init_jax_with_retry()
+
+    # read the workload AFTER init: a tunnel-down fallback shrinks the
+    # parameters via environment defaults set inside the retry helper
     n = int(os.environ.get("BENCH_N", "16"))
     t = int(os.environ.get("BENCH_T", "8"))
     bits = int(os.environ.get("BENCH_BITS", "2048"))
     m_sec = int(os.environ.get("BENCH_M", "256"))
     sessions_count = int(os.environ.get("BENCH_SESSIONS", "1"))
     joins = int(os.environ.get("BENCH_JOIN", "0"))
-
-    jax, _ = init_jax_with_retry()
 
     from fsdkr_tpu.config import ProtocolConfig
     from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen

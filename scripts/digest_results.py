@@ -53,16 +53,21 @@ def main():
 
     if configs:
         print("### collect() configurations\n")
-        print("| step | metric | proofs/s | warm s | cold s | vs native C++ | vs CPython |")
-        print("|---|---|---|---|---|---|---|")
+        print("| step | metric | platform | proofs/s | warm s | cold s | vs native C++ | vs CPython |")
+        print("|---|---|---|---|---|---|---|---|")
         for name, r in configs:
+            plat = r.get("platform") or "—"
+            if r.get("fallback_note"):
+                plat += " (FALLBACK)"
             print(
-                f"| {name} | {r['metric']} | {r.get('value', 0)} "
+                f"| {name} | {r['metric']} | {plat} | {r.get('value', 0)} "
                 f"| {r.get('collect_warm_s', '—')} | {r.get('collect_cold_s', '—')} "
                 f"| {r.get('vs_baseline', '—')}x | {r.get('vs_cpython', '—')}x |"
             )
             if "error" in r:
-                print(f"|  | ERROR: {r['error'][:90]} | | | | | |")
+                print(f"|  | ERROR: {r['error'][:90]} | | | | | | |")
+            if r.get("fallback_note"):
+                print(f"|  | note: {r['fallback_note'][:110]} | | | | | | |")
         print()
 
     for name, (tr, mfu) in traces.items():
@@ -93,10 +98,11 @@ def main():
         print("| shape | n | rows | platform | host s | device warm s | device speedup |")
         print("|---|---|---|---|---|---|---|")
         for r in ec_ab:
+            speedup = r.get("device_speedup_warm")
             print(
                 f"| {r['shape']} | {r['n']} | {r['rows']} | {r['platform']} "
-                f"| {r.get('host_s', '—')} | {r.get('device_warm_s', '—')} "
-                f"| {r.get('device_speedup_warm', '—')}x |"
+                f"| {r.get('host_s') or '—'} | {r.get('device_warm_s') or '—'} "
+                f"| {f'{speedup}x' if speedup is not None else '—'} |"
             )
         print()
 

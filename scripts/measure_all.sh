@@ -8,6 +8,10 @@ cd /root/repo
 R=/root/repo/bench_results
 mkdir -p "$R"
 echo $$ > "$R/.battery.pid"
+# wait_healthy already gates every step on the tunnel: keep bench.py
+# fail-hard here so a step that races a mid-run outage errors out
+# instead of silently burning its timeout on the CPU platform
+export BENCH_CPU_FALLBACK=0
 
 probe() {  # 0 = healthy
   timeout 120 python - <<'EOF' > /dev/null 2>&1
