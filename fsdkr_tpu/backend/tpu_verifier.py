@@ -81,7 +81,13 @@ class TpuBatchVerifier(BatchVerifier):
     # ------------------------------------------------------------------
     def _pdl_prepare(self, items):
         """Recompute challenges; return (the family's 5 modexp columns,
-        carry state for _pdl_finish). Column order matches _pdl_finish."""
+        carry state for _pdl_finish). Column order matches _pdl_finish.
+
+        Exponent-position proof fields (s1, s3) are attacker-chosen wire
+        integers: a negative value would crash the limb encoder mid-batch
+        (no identifiable abort) rather than fail one row, so out-of-domain
+        rows are staged with zeros and force-failed in _pdl_finish. Base-
+        position fields reduce mod n on staging and need no gate."""
         with phase("pdl.challenge", items=len(items)):
             e_vec = [
                 PDLwSlackProof._challenge(
@@ -89,20 +95,23 @@ class TpuBatchVerifier(BatchVerifier):
                 )
                 for p, st in items
             ]
+        row_ok = [p.s1 >= 0 and p.s3 >= 0 for p, _ in items]
+        s1_col = [p.s1 if ok else 0 for (p, _), ok in zip(items, row_ok)]
+        s3_col = [p.s3 if ok else 0 for (p, _), ok in zip(items, row_ok)]
         nn_mod = [st.ek.nn for _, st in items]
         nt_mod = [st.N_tilde for _, st in items]
         cols = (
             ([st.ciphertext for _, st in items], e_vec, nn_mod),
             ([p.s2 for p, _ in items], [st.ek.n for _, st in items], nn_mod),
             ([p.z for p, _ in items], e_vec, nt_mod),
-            ([st.h1 for _, st in items], [p.s1 for p, _ in items], nt_mod),
-            ([st.h2 for _, st in items], [p.s3 for p, _ in items], nt_mod),
+            ([st.h1 for _, st in items], s1_col, nt_mod),
+            ([st.h2 for _, st in items], s3_col, nt_mod),
         )
-        return cols, (e_vec, nn_mod, nt_mod)
+        return cols, (e_vec, nn_mod, nt_mod, row_ok)
 
     def _pdl_finish(self, items, state, results):
         """Combine the 5 modexp column results into per-row verdicts."""
-        e_vec, nn_mod, nt_mod = state
+        e_vec, nn_mod, nt_mod, row_ok = state
         c_e, s2_n, z_e, h1_s1, h2_s3 = results
         with phase("pdl.combine", items=len(items)):
             lhs2 = _modmul([p.u2 for p, _ in items], c_e, nn_mod)
@@ -118,9 +127,9 @@ class TpuBatchVerifier(BatchVerifier):
 
         out = []
         for idx, (proof, st) in enumerate(items):
-            ok1 = ok1_vec[idx]
-            ok2 = lhs2[idx] == rhs2[idx]
-            ok3 = lhs3[idx] == rhs3[idx]
+            ok1 = ok1_vec[idx] and row_ok[idx]
+            ok2 = lhs2[idx] == rhs2[idx] and row_ok[idx]
+            ok3 = lhs3[idx] == rhs3[idx] and row_ok[idx]
             out.append(None if (ok1 and ok2 and ok3) else (ok1, ok2, ok3))
         return out
 
@@ -207,34 +216,41 @@ class TpuBatchVerifier(BatchVerifier):
 
     def _range_prepare(self, items):
         """Return (the family's 5 modexp columns, carry state for
-        _range_finish). Column order matches _range_finish."""
+        _range_finish). Column order matches _range_finish.
+
+        Same out-of-domain gating as _pdl_prepare: exponent-position wire
+        fields (s1, s2, e) must be non-negative or the row is staged with
+        zeros and force-failed — never crash the batch."""
         nn_mod = [ek.nn for _, _, ek, _ in items]
         nt_mod = [dlog.N for _, _, _, dlog in items]
-        e_vec = [p.e for p, _, _, _ in items]
+        row_ok = [
+            p.s1 >= 0 and p.s2 >= 0 and p.e >= 0 for p, _, _, _ in items
+        ]
+        e_vec = [
+            p.e if ok else 0 for (p, _, _, _), ok in zip(items, row_ok)
+        ]
+        s1_col = [
+            p.s1 if ok else 0 for (p, _, _, _), ok in zip(items, row_ok)
+        ]
+        s2_col = [
+            p.s2 if ok else 0 for (p, _, _, _), ok in zip(items, row_ok)
+        ]
         return (
             ([p.z for p, _, _, _ in items], e_vec, nt_mod),
-            (
-                [dlog.g for _, _, _, dlog in items],
-                [p.s1 for p, _, _, _ in items],
-                nt_mod,
-            ),
-            (
-                [dlog.ni for _, _, _, dlog in items],
-                [p.s2 for p, _, _, _ in items],
-                nt_mod,
-            ),
+            ([dlog.g for _, _, _, dlog in items], s1_col, nt_mod),
+            ([dlog.ni for _, _, _, dlog in items], s2_col, nt_mod),
             ([c for _, c, _, _ in items], e_vec, nn_mod),
             (
                 [p.s for p, _, _, _ in items],
                 [ek.n for _, _, ek, _ in items],
                 nn_mod,
             ),
-        ), (nn_mod, nt_mod)
+        ), (nn_mod, nt_mod, row_ok)
 
     def _range_finish(self, items, mods, results):
         q3 = CURVE_ORDER**3
 
-        nn_mod, nt_mod = mods
+        nn_mod, nt_mod, row_ok = mods
         z_e, h1_s1, h2_s2, c_e, s_n = results
 
         with phase("range.combine", items=len(items)):
@@ -249,7 +265,7 @@ class TpuBatchVerifier(BatchVerifier):
         with phase("range.challenge", items=len(items)):
             out = []
             for idx, (proof, cipher, ek, dlog) in enumerate(items):
-                if proof.s1 > q3 or proof.s1 < 0:
+                if not row_ok[idx] or proof.s1 > q3 or proof.s1 < 0:
                     out.append(False)
                     continue
                 z_e_inv = z_e_inv_vec[idx]
@@ -305,7 +321,14 @@ class TpuBatchVerifier(BatchVerifier):
         shapes_ok = []
         with phase("ringped.challenge", items=len(items)):
             for proof, st in items:
-                ok = len(proof.A) == m_security and len(proof.Z) == m_security
+                # Z_i ride the exponent position: negative wire values
+                # would crash the limb encoder, so gate them here
+                ok = (
+                    len(proof.A) == m_security
+                    and len(proof.Z) == m_security
+                    and all(z >= 0 for z in proof.Z)
+                    and all(a >= 0 for a in proof.A)
+                )
                 shapes_ok.append(ok)
                 if not ok:
                     continue
@@ -394,10 +417,11 @@ class TpuBatchVerifier(BatchVerifier):
                 for p, st in items
             ]
         moduli = [st.N for _, st in items]
+        # y rides the exponent position: stage invalid rows with 0 and
+        # fail them via the existing y >= 0 gate below
+        y_col = [p.y if p.y >= 0 else 0 for p, _ in items]
         with phase("composite_dlog.modexp", items=2 * len(items)):
-            g_y = _modexp(
-                [st.g for _, st in items], [p.y for p, _ in items], moduli
-            )
+            g_y = _modexp([st.g for _, st in items], y_col, moduli)
             ni_e = _modexp([st.ni for _, st in items], e_vec, moduli)
             lhs = _modmul(g_y, ni_e, moduli)
         return [

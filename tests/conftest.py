@@ -122,3 +122,16 @@ def test_config():
     host oracle runs the full protocol in seconds; full-size runs are marked
     `slow`."""
     return TEST_CONFIG
+
+
+@pytest.fixture(scope="session")
+def one_refresh_round(test_config):
+    """One honest (t=1, n=3) refresh round: (keys-post-distribute,
+    messages, new dks). Shared by the object-level (test_tamper) and
+    wire-level (test_wire_negative) adversarial suites — consumers must
+    deepcopy messages / clone keys before mutating."""
+    from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+
+    keys = simulate_keygen(1, 3, test_config)
+    out = [RefreshMessage.distribute(k.i, k, 3, test_config) for k in keys]
+    return keys, [m for m, _ in out], [dk for _, dk in out]

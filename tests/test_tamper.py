@@ -25,15 +25,13 @@ from fsdkr_tpu.errors import (
     RingPedersenProofError,
     SizeMismatchError,
 )
-from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+from fsdkr_tpu.protocol import RefreshMessage
 
 
 @pytest.fixture(scope="module")
-def refreshed(test_config):
-    """One honest refresh round: keys (post-distribute), messages, dks."""
-    keys = simulate_keygen(1, 3, test_config)
-    out = [RefreshMessage.distribute(k.i, k, 3, test_config) for k in keys]
-    return keys, [m for m, _ in out], [dk for _, dk in out]
+def refreshed(one_refresh_round):
+    """Shared honest round (see conftest.one_refresh_round)."""
+    return one_refresh_round
 
 
 def _collect_tampered(refreshed, config, mutate, collector=0):

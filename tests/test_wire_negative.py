@@ -1,0 +1,131 @@
+"""Wire-format negatives: malformed or tampered broadcast bytes must fail
+closed at decode time or be rejected by collect — never decode into a
+message that verifies. Complements tests/test_tamper.py (object-level)
+with byte/JSON-level adversarial inputs, per the reference's
+serde-everything wire surface (`src/refresh_message.rs:29-30`)."""
+
+import json
+
+import pytest
+
+from fsdkr_tpu.core.secp256k1 import P, Point
+from fsdkr_tpu.errors import FsDkrError
+from fsdkr_tpu.protocol import RefreshMessage
+from fsdkr_tpu.protocol.serialization import (
+    refresh_message_from_json,
+    refresh_message_to_json,
+)
+
+
+class TestPointDecoding:
+    def test_off_curve_point_rejected(self):
+        # x = 5 with forced even-y prefix: 5^3+7 = 132 is a QR? decode
+        # validates y^2 == x^3+7; craft an x whose rhs is a non-residue
+        for x in range(2, 40):
+            blob = bytes([2]) + x.to_bytes(32, "big")
+            try:
+                p = Point.from_bytes(blob)
+            except ValueError:
+                break  # found a non-residue x: rejection path exercised
+            assert (p.y * p.y - (p.x**3 + 7)) % P == 0
+        else:
+            pytest.fail("no non-residue x found in range (unexpected)")
+
+    def test_non_canonical_x_rejected(self):
+        with pytest.raises(ValueError):
+            Point.from_bytes(bytes([2]) + (P + 1).to_bytes(32, "big"))
+
+    def test_bad_prefix_rejected(self):
+        with pytest.raises(ValueError):
+            Point.from_bytes(bytes([7]) + (5).to_bytes(32, "big"))
+
+
+@pytest.fixture(scope="module")
+def one_round(one_refresh_round):
+    """Shared honest round (see conftest.one_refresh_round)."""
+    return one_refresh_round
+
+
+class TestWireTamper:
+    def test_truncated_json_rejected(self, one_round):
+        _, msgs, _ = one_round
+        wire = refresh_message_to_json(msgs[0])
+        with pytest.raises((json.JSONDecodeError, KeyError, ValueError)):
+            refresh_message_from_json(wire[: len(wire) // 2])
+
+    def test_missing_field_rejected(self, one_round):
+        _, msgs, _ = one_round
+        d = json.loads(refresh_message_to_json(msgs[0]))
+        del d["ek"]
+        with pytest.raises((KeyError, ValueError, TypeError)):
+            refresh_message_from_json(json.dumps(d))
+
+    def test_bitflipped_ciphertext_rejected_by_collect(
+        self, one_round, test_config
+    ):
+        """A single hex-digit flip in a broadcast ciphertext decodes fine
+        (it is just an integer) but must be caught by the PDL proof that
+        binds it."""
+        keys, msgs, dks = one_round
+        d = json.loads(refresh_message_to_json(msgs[1]))
+        c = d["points_encrypted_vec"][0]
+        d["points_encrypted_vec"][0] = ("0" if c[0] != "0" else "1") + c[1:]
+        evil = refresh_message_from_json(json.dumps(d))
+        wire_msgs = [msgs[0], evil, msgs[2]]
+        with pytest.raises(FsDkrError):
+            RefreshMessage.collect(
+                wire_msgs, keys[0].clone(), dks[0], (), test_config
+            )
+
+    # batched-backend collects cost ~11 s each on the CPU platform: keep
+    # the smoke gate under 3 minutes (scripts/ci.sh), as in test_tamper
+    @pytest.mark.parametrize(
+        "backend", ["host", pytest.param("tpu", marks=pytest.mark.heavy)]
+    )
+    @pytest.mark.parametrize(
+        "field,proof_key",
+        [
+            ("range_proofs", "s1"),
+            ("range_proofs", "s2"),
+            ("pdl_proof_vec", "s1"),
+            ("pdl_proof_vec", "s3"),
+        ],
+    )
+    def test_negative_int_through_wire_rejected(
+        self, one_round, test_config, backend, field, proof_key
+    ):
+        """Hex int decoding admits a leading minus sign; a negative
+        exponent-position field smuggled through the wire must yield an
+        identifiable-abort FsDkrError on BOTH backends — on the batched
+        backend it must fail its row, not crash the limb encoder."""
+        keys, msgs, dks = one_round
+        d = json.loads(refresh_message_to_json(msgs[1]))
+        d[field][0][proof_key] = "-" + d[field][0][proof_key]
+        evil = refresh_message_from_json(json.dumps(d))
+        with pytest.raises(FsDkrError):
+            RefreshMessage.collect(
+                [msgs[0], evil, msgs[2]],
+                keys[0].clone(),
+                dks[0],
+                (),
+                test_config.with_backend(backend),
+            )
+
+    @pytest.mark.parametrize(
+        "backend", ["host", pytest.param("tpu", marks=pytest.mark.heavy)]
+    )
+    def test_negative_ringpedersen_z_through_wire_rejected(
+        self, one_round, test_config, backend
+    ):
+        keys, msgs, dks = one_round
+        d = json.loads(refresh_message_to_json(msgs[1]))
+        d["ring_pedersen_proof"]["Z"][0] = "-" + d["ring_pedersen_proof"]["Z"][0]
+        evil = refresh_message_from_json(json.dumps(d))
+        with pytest.raises(FsDkrError):
+            RefreshMessage.collect(
+                [msgs[0], evil, msgs[2]],
+                keys[0].clone(),
+                dks[0],
+                (),
+                test_config.with_backend(backend),
+            )
