@@ -41,6 +41,11 @@ from ..proofs.ring_pedersen import RingPedersenProof
 from ..utils.trace import phase
 from .batch_verifier import BatchVerifier, HostBatchVerifier
 
+# honest-value width caps for wire integers (domain gates in the
+# prepare/verify methods): q^3 is the slack-range bound of the GG-style
+# sigma protocols (`src/range_proofs.rs:125`)
+_Q3 = CURVE_ORDER**3
+
 
 def _modexp(bases, exps, moduli) -> List[int]:
     """One batched multi-modulus modexp launch. Rows sharing a (base,
@@ -85,17 +90,34 @@ class TpuBatchVerifier(BatchVerifier):
 
         Exponent-position proof fields (s1, s3) are attacker-chosen wire
         integers: a negative value would crash the limb encoder mid-batch
-        (no identifiable abort) rather than fail one row, so out-of-domain
-        rows are staged with zeros and force-failed in _pdl_finish. Base-
-        position fields reduce mod n on staging and need no gate."""
+        (no identifiable abort) rather than fail one row, and an
+        oversized one would inflate the whole fused launch's exponent
+        width (bucket_exp_bits sizes a column by its max) — a one-row
+        DoS. Out-of-domain rows are staged with zeros and force-failed
+        in _pdl_finish; base-position fields reduce mod n on staging.
+        Width caps: honest s1 = e*x + alpha < 2q^3 (832 bits of slack
+        used), s3 = e*rho + gamma < 2q^3 * N_tilde. Transcript-position
+        fields (z, u2, u3, ciphertext) must also be gated BEFORE hashing:
+        chain_int rejects negatives with a raw ValueError."""
+        row_ok = [
+            p.z >= 0
+            and p.u2 >= 0
+            and p.u3 >= 0
+            and st.ciphertext >= 0
+            and 0 <= p.s1 <= 2 * _Q3
+            and 0 <= p.s3
+            and p.s3.bit_length() <= st.N_tilde.bit_length() + 832
+            for p, st in items
+        ]
         with phase("pdl.challenge", items=len(items)):
             e_vec = [
                 PDLwSlackProof._challenge(
                     st, p.z, p.u1, p.u2, p.u3, self.config.hash_alg
                 )
-                for p, st in items
+                if ok
+                else 0
+                for (p, st), ok in zip(items, row_ok)
             ]
-        row_ok = [p.s1 >= 0 and p.s3 >= 0 for p, _ in items]
         s1_col = [p.s1 if ok else 0 for (p, _), ok in zip(items, row_ok)]
         s3_col = [p.s3 if ok else 0 for (p, _), ok in zip(items, row_ok)]
         nn_mod = [st.ek.nn for _, st in items]
@@ -219,12 +241,22 @@ class TpuBatchVerifier(BatchVerifier):
         _range_finish). Column order matches _range_finish.
 
         Same out-of-domain gating as _pdl_prepare: exponent-position wire
-        fields (s1, s2, e) must be non-negative or the row is staged with
-        zeros and force-failed — never crash the batch."""
+        fields (s1, s2, e) must be in their honest domains or the row is
+        staged with zeros and force-failed — never crash or inflate the
+        batch. s1's q^3 slack bound (`src/range_proofs.rs:125`) is
+        enforced HERE, pre-launch. Transcript fields (z, cipher, s) are
+        gated non-negative for chain_int."""
         nn_mod = [ek.nn for _, _, ek, _ in items]
         nt_mod = [dlog.N for _, _, _, dlog in items]
         row_ok = [
-            p.s1 >= 0 and p.s2 >= 0 and p.e >= 0 for p, _, _, _ in items
+            0 <= p.s1 <= _Q3
+            and 0 <= p.s2
+            and p.s2.bit_length() <= dlog.N.bit_length() + 832
+            and 0 <= p.e < (1 << 256)
+            and p.z >= 0
+            and p.s >= 0
+            and c >= 0
+            for p, c, _, dlog in items
         ]
         e_vec = [
             p.e if ok else 0 for (p, _, _, _), ok in zip(items, row_ok)
@@ -248,8 +280,6 @@ class TpuBatchVerifier(BatchVerifier):
         ), (nn_mod, nt_mod, row_ok)
 
     def _range_finish(self, items, mods, results):
-        q3 = CURVE_ORDER**3
-
         nn_mod, nt_mod, row_ok = mods
         z_e, h1_s1, h2_s2, c_e, s_n = results
 
@@ -265,7 +295,8 @@ class TpuBatchVerifier(BatchVerifier):
         with phase("range.challenge", items=len(items)):
             out = []
             for idx, (proof, cipher, ek, dlog) in enumerate(items):
-                if not row_ok[idx] or proof.s1 > q3 or proof.s1 < 0:
+                # row_ok is the single domain gate (incl. the q^3 bound)
+                if not row_ok[idx]:
                     out.append(False)
                     continue
                 z_e_inv = z_e_inv_vec[idx]
@@ -319,15 +350,24 @@ class TpuBatchVerifier(BatchVerifier):
             return []
         bases, exps, moduli, rhs_a, rhs_s = [], [], [], [], []
         shapes_ok = []
+        n_cap = self.config.paillier_bits + 64
         with phase("ringped.challenge", items=len(items)):
             for proof, st in items:
-                # Z_i ride the exponent position: negative wire values
-                # would crash the limb encoder, so gate them here
+                # the statement modulus and the proof vectors are wire
+                # data: an even/tiny N crashes the Montgomery context, a
+                # negative A_i/Z_i crashes the limb encoder or the
+                # transcript, and oversized values inflate the launch —
+                # gate the row instead (honest: A_i < N, Z_i < phi < N)
                 ok = (
                     len(proof.A) == m_security
                     and len(proof.Z) == m_security
-                    and all(z >= 0 for z in proof.Z)
-                    and all(a >= 0 for a in proof.A)
+                    and st.N > 2
+                    and st.N % 2 == 1
+                    and st.N.bit_length() <= n_cap
+                    and 0 <= st.S < st.N
+                    and 0 <= st.T < st.N
+                    and all(0 <= z < st.N for z in proof.Z)
+                    and all(0 <= a < st.N for a in proof.A)
                 )
                 shapes_ok.append(ok)
                 if not ok:
@@ -366,6 +406,7 @@ class TpuBatchVerifier(BatchVerifier):
 
         bases, exps, moduli, want = [], [], [], []
         gates = []
+        n_cap = self.config.paillier_bits + 64  # wire ek: cap the launch width
         with phase("correct_key.rho_derive", items=len(items)):
             for proof, ek in items:
                 n = ek.n
@@ -373,6 +414,7 @@ class TpuBatchVerifier(BatchVerifier):
                     len(proof.sigma_vec) == rounds
                     and n > 0
                     and n % 2 == 1
+                    and n.bit_length() <= n_cap
                     and math.gcd(n, correct_key._PRIMORIAL) == 1
                     and all(0 < s < n for s in proof.sigma_vec)
                 )
@@ -408,24 +450,40 @@ class TpuBatchVerifier(BatchVerifier):
     def verify_composite_dlog(self, items):
         if not items:
             return []
-        from ..proofs.composite_dlog import CompositeDLogProof
+        from ..proofs.composite_dlog import STAT_BITS, CompositeDLogProof
+
+        # the join statement (N, g, ni) and proof (x_commit, y) are all
+        # wire data: gate the row's domain before transcripts/staging
+        # (honest y = r + e*x < N * 2^(STAT_BITS + 256 + small))
+        n_cap = self.config.paillier_bits + 64
+        row_ok = [
+            st.N > 2
+            and st.N % 2 == 1
+            and st.N.bit_length() <= n_cap
+            and 0 <= st.g < st.N
+            and 0 <= st.ni < st.N
+            and 0 < p.x_commit < st.N
+            and 0 <= p.y
+            and p.y.bit_length() <= st.N.bit_length() + STAT_BITS + 320
+            for p, st in items
+        ]
         with phase("composite_dlog.challenge", items=len(items)):
             e_vec = [
                 CompositeDLogProof._challenge(
                     p.x_commit, st, self.config.hash_alg
                 )
-                for p, st in items
+                if ok
+                else 0
+                for (p, st), ok in zip(items, row_ok)
             ]
-        moduli = [st.N for _, st in items]
-        # y rides the exponent position: stage invalid rows with 0 and
-        # fail them via the existing y >= 0 gate below
-        y_col = [p.y if p.y >= 0 else 0 for p, _ in items]
+        moduli = [st.N if ok else 3 for (_, st), ok in zip(items, row_ok)]
+        y_col = [p.y if ok else 0 for (p, _), ok in zip(items, row_ok)]
         with phase("composite_dlog.modexp", items=2 * len(items)):
             g_y = _modexp([st.g for _, st in items], y_col, moduli)
             ni_e = _modexp([st.ni for _, st in items], e_vec, moduli)
             lhs = _modmul(g_y, ni_e, moduli)
         return [
-            0 < p.x_commit < st.N and p.y >= 0 and lhs[idx] == p.x_commit
+            row_ok[idx] and lhs[idx] == p.x_commit
             for idx, (p, st) in enumerate(items)
         ]
 

@@ -185,8 +185,12 @@ class AliceProof:
         h1, h2, n_tilde = dlog_statement.g, dlog_statement.ni, dlog_statement.N
         n, nn = alice_ek.n, alice_ek.nn
 
-        # range gate (/root/reference/src/range_proofs.rs:125)
+        # range gate (/root/reference/src/range_proofs.rs:125), plus
+        # fail-closed domain gates for the remaining integers (negative
+        # values would crash the transcript, not fail the proof)
         if self.s1 > q**3 or self.s1 < 0:
+            return False
+        if min(self.z, self.e, self.s, self.s2, cipher) < 0:
             return False
 
         z_e_inv = intops.mod_inv(intops.mod_pow(self.z, self.e, n_tilde), n_tilde)

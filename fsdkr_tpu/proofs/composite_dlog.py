@@ -71,6 +71,8 @@ class CompositeDLogProof:
     def verify(self, st: DLogStatement, hash_alg: str | None = None) -> bool:
         if not (0 < self.x_commit < st.N) or self.y < 0:
             return False
+        if st.N <= 2 or st.g < 0 or st.ni < 0:  # fail closed, no crash
+            return False
         e = CompositeDLogProof._challenge(self.x_commit, st, hash_alg)
         lhs = intops.mod_pow(st.g, self.y, st.N) * intops.mod_pow(st.ni, e, st.N) % st.N
         return lhs == self.x_commit

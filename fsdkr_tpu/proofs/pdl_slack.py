@@ -223,7 +223,16 @@ class PDLwSlackProof:
 
     def verify(self, st: PDLwSlackStatement, hash_alg: str | None = None) -> None:
         """Raises PDLwSlackProofError with per-equation booleans on failure
-        (reference `src/zk_pdl_with_slack.rs:158-166`)."""
+        (reference `src/zk_pdl_with_slack.rs:158-166`).
+
+        Out-of-domain integers (negative proof fields or ciphertext —
+        possible for in-process objects; the wire decode is strict) fail
+        closed with the proof error instead of crashing the transcript."""
+        if (
+            min(self.z, self.u2, self.u3, self.s1, self.s2, self.s3) < 0
+            or st.ciphertext < 0
+        ):
+            raise PDLwSlackProofError(False, False, False)
         e = PDLwSlackProof._challenge(
             st, self.z, self.u1, self.u2, self.u3, hash_alg
         )

@@ -136,6 +136,10 @@ class RingPedersenProof:
         (reference `src/ring_pedersen_proof.rs:138-155`)."""
         if len(self.A) != m_security or len(self.Z) != m_security:
             raise RingPedersenProofError()
+        # fail closed on out-of-domain integers (in-process objects; the
+        # wire decode is strict): negatives crash transcript/pow paths
+        if st.N <= 2 or any(a < 0 for a in self.A) or any(z < 0 for z in self.Z):
+            raise RingPedersenProofError()
         e = RingPedersenProof._challenge(self.A, hash_alg)
         bits = challenge_bits(e, m_security, hash_alg)
         for a_i, z_i, b in zip(self.A, self.Z, bits):

@@ -42,7 +42,18 @@ def _int_enc(x: int) -> str:
     return format(x, "x")
 
 
+_HEX = frozenset("0123456789abcdef")
+
+
 def _int_dec(s: str) -> int:
+    """Strict canonical decode: lowercase hex magnitude only. int(s, 16)
+    would admit a leading minus (letting an attacker smuggle negative
+    values into exponent/transcript positions), '+', underscores, and
+    whitespace — none of which the encoder ever emits. Malformed wire
+    bytes fail closed HERE, at message decode, where the caller knows
+    exactly which party sent them."""
+    if not isinstance(s, str) or not s or not _HEX.issuperset(s):
+        raise ValueError(f"non-canonical wire integer: {s!r:.40}")
     return int(s, 16)
 
 

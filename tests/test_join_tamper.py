@@ -1,0 +1,122 @@
+"""Adversarial tamper matrix for the join path: a malicious joining
+party's broadcast (JoinMessage, `/root/reference/src/add_party_message.rs:36-45`)
+is perturbed field by field; the existing committee's collect must reject
+it with the matching identifiable-abort error.
+
+Complements tests/test_tamper.py (RefreshMessage surface). The joining
+party's own collect deliberately verifies less (reference behavior,
+SURVEY.md §3.4) — these cases exercise the EXISTING members' acceptance
+gates for a new party (`protocol/refresh.py` collect_sessions join
+adoption: correct-key, both-direction composite-dlog, moduli size,
+ring-Pedersen)."""
+
+import copy
+import dataclasses
+
+import pytest
+
+from fsdkr_tpu.errors import (
+    DLogProofValidation,
+    ModuliTooSmall,
+    PaillierVerificationError,
+    RingPedersenProofError,
+)
+from fsdkr_tpu.protocol import JoinMessage, RefreshMessage
+from fsdkr_tpu.protocol.join import JoinMessage as _JM
+
+
+@pytest.fixture(scope="module")
+def join_round(test_config):
+    """(t=1, n=3) committee admits one new party at index 4: existing
+    members run replace+distribute, the join broadcasts its message."""
+    from fsdkr_tpu.protocol import simulate_keygen
+
+    keys = simulate_keygen(1, 3, test_config)
+    join_msg, pair = JoinMessage.distribute(test_config)
+    join_msg.set_party_index(4)
+    new_n = 4
+    out = [
+        RefreshMessage.replace(
+            [join_msg], k, {i + 1: i + 1 for i in range(3)}, new_n, test_config
+        )
+        for k in keys
+    ]
+    return keys, [m for m, _ in out], [dk for _, dk in out], join_msg, pair
+
+
+def _collect_with_join(join_round, config, mutate):
+    keys, msgs, dks, join_msg, _pair = join_round
+    evil = copy.deepcopy(join_msg)
+    mutate(evil)
+    RefreshMessage.collect(
+        copy.deepcopy(msgs), keys[0].clone(), dks[0], (evil,), config
+    )
+
+
+CASES = [
+    (
+        "correct_key_sigma",
+        PaillierVerificationError,
+        lambda j: j.dk_correctness_proof.sigma_vec.__setitem__(
+            0, j.dk_correctness_proof.sigma_vec[0] + 1
+        ),
+    ),
+    (
+        "composite_dlog_y",
+        DLogProofValidation,
+        lambda j: setattr(
+            j,
+            "composite_dlog_proof_base_h1",
+            dataclasses.replace(
+                j.composite_dlog_proof_base_h1,
+                y=j.composite_dlog_proof_base_h1.y + 1,
+            ),
+        ),
+    ),
+    (
+        "composite_dlog_swapped",
+        DLogProofValidation,
+        lambda j: (
+            lambda h1, h2: (
+                setattr(j, "composite_dlog_proof_base_h1", h2),
+                setattr(j, "composite_dlog_proof_base_h2", h1),
+            )
+        )(j.composite_dlog_proof_base_h1, j.composite_dlog_proof_base_h2),
+    ),
+    (
+        "ek_too_small",
+        (PaillierVerificationError, ModuliTooSmall),
+        lambda j: setattr(j, "ek", type(j.ek).from_n((1 << 520) + 21)),
+    ),
+    (
+        "ring_pedersen_Z",
+        RingPedersenProofError,
+        lambda j: j.ring_pedersen_proof.Z.__setitem__(
+            0, j.ring_pedersen_proof.Z[0] + 1
+        ),
+    ),
+]
+
+
+@pytest.mark.parametrize("name,err,mutate", CASES, ids=[c[0] for c in CASES])
+def test_tampered_join_rejected(join_round, test_config, name, err, mutate):
+    with pytest.raises(err):
+        _collect_with_join(join_round, test_config, mutate)
+
+
+def test_honest_join_accepted(join_round, test_config):
+    """Baseline: the fixture's join is genuinely valid, and the new
+    party derives a working LocalKey whose share matches the committee."""
+    keys, msgs, dks, join_msg, pair = join_round
+    _collect_with_join(join_round, test_config, lambda j: None)
+    new_key = join_msg.collect(
+        copy.deepcopy(msgs), pair, (join_msg,), 1, 4, test_config
+    )
+    assert new_key.i == 4
+    from fsdkr_tpu.core.secp256k1 import GENERATOR
+
+    assert GENERATOR * new_key.keys_linear.x_i == new_key.pk_vec[3]
+    assert new_key.y_sum_s == keys[0].y_sum_s
+
+
+assert _JM is JoinMessage  # module wiring sanity

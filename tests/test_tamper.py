@@ -111,6 +111,45 @@ CASES = [
         SizeMismatchError,
         lambda msgs: msgs[1].points_encrypted_vec.pop(),
     ),
+    # ---- out-of-domain integers (in-process objects bypass the strict
+    # wire decode): the batched backend must fail the row with the same
+    # identifiable-abort error as the host oracle — never crash the limb
+    # encoder / transcript, never inflate the fused launch width --------
+    (
+        "negative_range_s1",
+        RangeProofError,
+        lambda msgs: msgs[1].range_proofs.__setitem__(
+            0, dataclasses.replace(msgs[1].range_proofs[0], s1=-5)
+        ),
+    ),
+    (
+        "negative_pdl_s3",
+        PDLwSlackProofError,
+        lambda msgs: msgs[1].pdl_proof_vec.__setitem__(
+            0, dataclasses.replace(msgs[1].pdl_proof_vec[0], s3=-5)
+        ),
+    ),
+    (
+        "negative_pdl_z",
+        PDLwSlackProofError,  # transcript-position field
+        lambda msgs: msgs[1].pdl_proof_vec.__setitem__(
+            0, dataclasses.replace(msgs[1].pdl_proof_vec[0], z=-5)
+        ),
+    ),
+    (
+        "negative_ringped_Z",
+        RingPedersenProofError,
+        lambda msgs: msgs[1].ring_pedersen_proof.Z.__setitem__(0, -5),
+    ),
+    (
+        "huge_range_s1_dos",
+        RangeProofError,  # width cap: must fail the row pre-launch, not
+        # pad every row of the fused column to 2^20-bit exponents
+        lambda msgs: msgs[1].range_proofs.__setitem__(
+            0,
+            dataclasses.replace(msgs[1].range_proofs[0], s1=1 << (1 << 20)),
+        ),
+    ),
 ]
 
 
