@@ -51,6 +51,11 @@ def main():
     from fsdkr_tpu.ops.ec_batch import batch_msm, batch_scalar_mul
 
     ns = [int(x) for x in os.environ.get("BENCH_EC_NS", "16,64,256").split(",")]
+    # BENCH_EC_SHAPES=feldman (comma list) restricts to a subset — the
+    # u1msm device shape at n=256 costs ~40 min on the CPU platform
+    shapes = set(
+        os.environ.get("BENCH_EC_SHAPES", "genmul,u1msm,feldman").split(",")
+    )
     results = []
 
     def emit(shape, n, rows, host_s, dev_cold, dev_warm):
@@ -73,47 +78,61 @@ def main():
         t = n // 2
         rows = n * n
 
+        host_pts = None
+        if shapes & {"genmul", "u1msm"}:
+            scalars = [secrets.randbelow(N) for _ in range(rows)]
+            t0 = time.time()
+            host_pts = [GENERATOR * Scalar.from_int(s) for s in scalars]
+            host_s = time.time() - t0
+
         # --- genmul: s*G fan-out ---------------------------------------
-        scalars = [secrets.randbelow(N) for _ in range(rows)]
-        t0 = time.time()
-        host_pts = [GENERATOR * Scalar.from_int(s) for s in scalars]
-        host_s = time.time() - t0
-        t0 = time.time()
-        dev_pts = batch_scalar_mul([GENERATOR] * rows, scalars)
-        cold = time.time() - t0
-        t0 = time.time()
-        dev_pts = batch_scalar_mul([GENERATOR] * rows, scalars)
-        warm = time.time() - t0
-        assert dev_pts == host_pts, f"genmul mismatch at n={n}"
-        emit("genmul", n, rows, host_s, cold, warm)
-        log(f"n={n} genmul: host {host_s:.2f}s dev {warm:.2f}s")
+        if "genmul" in shapes:
+            t0 = time.time()
+            dev_pts = batch_scalar_mul([GENERATOR] * rows, scalars)
+            cold = time.time() - t0
+            t0 = time.time()
+            dev_pts = batch_scalar_mul([GENERATOR] * rows, scalars)
+            warm = time.time() - t0
+            assert dev_pts == host_pts, f"genmul mismatch at n={n}"
+            emit("genmul", n, rows, host_s, cold, warm)
+            log(f"n={n} genmul: host {host_s:.2f}s dev {warm:.2f}s")
 
         # --- u1: combined RLC check vs per-row host --------------------
-        # device: one group of 2*rows+1 points, 256-bit scalars
-        pts = host_pts[:rows] + host_pts[:rows] + [GENERATOR]
-        scs = [secrets.randbelow(N) for _ in range(2 * rows + 1)]
-        t0 = time.time()
-        (comb,) = batch_msm([pts], [scs])
-        cold = time.time() - t0
-        t0 = time.time()
-        (comb2,) = batch_msm([pts], [scs])
-        warm = time.time() - t0
-        assert comb == comb2
-        # host equivalent: 2 scalar muls + 1 add per row
-        sample = min(rows, 512)
-        t0 = time.time()
-        for i in range(sample):
-            _ = host_pts[i] * Scalar.from_int(scs[i]) + host_pts[i] * Scalar.from_int(scs[rows + i])
-        host_s = (time.time() - t0) / sample * rows
-        emit("u1msm", n, 2 * rows + 1, host_s, cold, warm)
-        log(f"n={n} u1: host(2muls/row, extrap) {host_s:.2f}s dev-msm {warm:.2f}s")
+        if "u1msm" in shapes:
+            # device: one group of 2*rows+1 points, 256-bit scalars
+            pts = host_pts[:rows] + host_pts[:rows] + [GENERATOR]
+            scs = [secrets.randbelow(N) for _ in range(2 * rows + 1)]
+            t0 = time.time()
+            (comb,) = batch_msm([pts], [scs])
+            cold = time.time() - t0
+            t0 = time.time()
+            (comb2,) = batch_msm([pts], [scs])
+            warm = time.time() - t0
+            assert comb == comb2
+            # host equivalent: 2 scalar muls + 1 add per row
+            sample = min(rows, 512)
+            t0 = time.time()
+            for i in range(sample):
+                _ = host_pts[i] * Scalar.from_int(scs[i]) + host_pts[i] * Scalar.from_int(scs[rows + i])
+            host_s = (time.time() - t0) / sample * rows
+            emit("u1msm", n, 2 * rows + 1, host_s, cold, warm)
+            log(f"n={n} u1: host(2muls/row, extrap) {host_s:.2f}s dev-msm {warm:.2f}s")
 
+        if "feldman" not in shapes:
+            continue
         # --- feldman: n groups of (n + t + 1) --------------------------
         params = ShamirSecretSharing(t, n)
         scheme = VerifiableSS(
             params, [GENERATOR * Scalar.from_int(i + 2) for i in range(t + 1)]
         )
-        share_pts = [host_pts[i] for i in range(n)]
+        share_pts = (
+            host_pts[:n]
+            if host_pts is not None
+            else [
+                GENERATOR * Scalar.from_int(secrets.randbelow(N))
+                for _ in range(n)
+            ]
+        )
         groups_pts, groups_scs = [], []
         for _ in range(n):
             rho = [secrets.randbits(128) for _ in range(n)]
