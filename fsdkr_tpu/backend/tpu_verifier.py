@@ -27,10 +27,9 @@ microseconds against milliseconds of 2048-bit modexp.
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List
 
 from ..config import ProtocolConfig, DEFAULT_CONFIG
-from ..core import intops
 from ..core.secp256k1 import N as CURVE_ORDER
 from ..core.secp256k1 import Scalar
 from ..core.transcript import challenge_bits

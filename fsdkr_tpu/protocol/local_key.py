@@ -7,7 +7,7 @@ mutation sites `src/refresh_message.rs:64,315-317,394,436,446-464`).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List
 
 from ..core.paillier import DecryptionKey, EncryptionKey

@@ -13,7 +13,6 @@ data (reference quirk 7).
 from __future__ import annotations
 
 import json
-from typing import Any
 
 from ..core.paillier import DecryptionKey, EncryptionKey
 from ..core.secp256k1 import Point, Scalar

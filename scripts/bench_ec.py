@@ -46,7 +46,7 @@ def main():
 
     import secrets
 
-    from fsdkr_tpu.core.secp256k1 import GENERATOR, N, Point, Scalar
+    from fsdkr_tpu.core.secp256k1 import GENERATOR, N, Scalar
     from fsdkr_tpu.core.vss import ShamirSecretSharing, VerifiableSS
     from fsdkr_tpu.ops.ec_batch import batch_msm, batch_scalar_mul
 

@@ -6,8 +6,8 @@
 #   test   -> the smoke tier: quick suite minus `heavy` kernel
 #             differentials (pytest.ini already excludes `slow`);
 #             session-scoped keygen caching makes this the <3 min gate
-#   lint   -> compileall is the only static gate available (no
-#             pyflakes/ruff/black in the image; documented substitute)
+#   lint   -> compileall + scripts/lint_imports.py (ast-based unused-
+#             import check; no pyflakes/ruff/black in the image)
 # Full suite on demand: pytest tests/ -m "not slow" (quick) or
 # pytest tests/ -m "" (everything, ~hours on this box).
 set -e
@@ -23,6 +23,9 @@ from fsdkr_tpu.protocol import RefreshMessage, JoinMessage  # API surface
 from fsdkr_tpu import config, errors
 print("import ok:", fsdkr_tpu.__name__)
 EOF
+
+echo "== lint: unused imports =="
+python scripts/lint_imports.py fsdkr_tpu tests scripts bench.py __graft_entry__.py
 
 echo "== test: smoke tier =="
 python -m pytest tests/ -q -m "not slow and not heavy" -p no:cacheprovider

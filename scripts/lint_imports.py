@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Minimal static lint for an image without pyflakes/ruff: flags unused
+imports, per file, via the ast module. Conservative by design —
+`__all__` entries, re-export modules (__init__.py), names starting with
+'_', and names referenced from quoted string annotations are exempt.
+
+Usage: python scripts/lint_imports.py [paths...]   (default: fsdkr_tpu)
+Exit code 1 if any finding (ci.sh lint gate).
+"""
+
+import ast
+import pathlib
+import sys
+
+
+def check_file(path: pathlib.Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    if path.name == "__init__.py":
+        return []  # re-export wiring: imports are the point
+
+    exported = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    try:
+                        exported = set(ast.literal_eval(node.value))
+                    except ValueError:
+                        pass
+
+    imported = {}  # name -> lineno
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = (a.asname or a.name).split(".")[0]
+                imported[name] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue  # compiler directives, not names
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                imported[a.asname or a.name] = node.lineno
+
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # quoted annotations ('-> "ProtocolConfig"', TYPE_CHECKING
+            # uses) reference names as strings: count their roots as used
+            try:
+                sub = ast.parse(node.value, mode="eval")
+            except SyntaxError:
+                continue
+            for n in ast.walk(sub):
+                if isinstance(n, ast.Name):
+                    used.add(n.id)
+        elif isinstance(node, ast.Attribute):
+            # record the root of dotted access: jax.numpy -> jax
+            n = node
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+
+    findings = []
+    for name, lineno in sorted(imported.items(), key=lambda kv: kv[1]):
+        if name in used or name in exported or name.startswith("_"):
+            continue
+        findings.append(f"{path}:{lineno}: unused import {name!r}")
+    return findings
+
+
+def main():
+    roots = [pathlib.Path(p) for p in (sys.argv[1:] or ["fsdkr_tpu"])]
+    findings = []
+    for root in roots:
+        if not root.exists():
+            # a renamed/misspelled root must fail the gate, not silently
+            # shrink its coverage to nothing
+            print(f"lint_imports: no such path: {root}", file=sys.stderr)
+            return 1
+        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        for f in files:
+            findings += check_file(f)
+    for line in findings:
+        print(line)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

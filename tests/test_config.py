@@ -1,8 +1,6 @@
 """ProtocolConfig routing knobs: EC device/host dispatch and the
 accelerator probe's failure-caching semantics."""
 
-import numpy as np
-
 from fsdkr_tpu import config as cfgmod
 from fsdkr_tpu.config import ProtocolConfig
 

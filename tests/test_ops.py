@@ -4,7 +4,6 @@ Python-int oracle). Runs on the virtual CPU platform (see conftest)."""
 
 import secrets
 
-import numpy as np
 import pytest
 
 from fsdkr_tpu.core import primes

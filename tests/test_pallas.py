@@ -11,7 +11,7 @@ import numpy as np
 import pytest
 
 from fsdkr_tpu.ops import rns
-from fsdkr_tpu.ops.limbs import LIMB_BITS, ints_to_limbs
+from fsdkr_tpu.ops.limbs import LIMB_BITS
 
 BITS = 512
 LIMBS = BITS // LIMB_BITS

@@ -9,7 +9,7 @@ import pytest
 
 from fsdkr_tpu.config import TEST_CONFIG
 from fsdkr_tpu.core import intops, paillier
-from fsdkr_tpu.core.secp256k1 import GENERATOR, Point, Scalar
+from fsdkr_tpu.core.secp256k1 import GENERATOR, Scalar
 from fsdkr_tpu.errors import PDLwSlackProofError, RingPedersenProofError
 from fsdkr_tpu.proofs import (
     AliceProof,

@@ -10,7 +10,6 @@ import pytest
 
 from fsdkr_tpu.config import TEST_CONFIG
 from fsdkr_tpu.core import vss
-from fsdkr_tpu.core.secp256k1 import Scalar
 from fsdkr_tpu.errors import FsDkrError, PartiesThresholdViolation
 from fsdkr_tpu.protocol import (
     JoinMessage,

@@ -9,7 +9,7 @@ import random
 import pytest
 
 from fsdkr_tpu.core import primes
-from fsdkr_tpu.ops.rns import RNSBases, rns_bases_for_bits, rns_modexp
+from fsdkr_tpu.ops.rns import rns_bases_for_bits, rns_modexp
 
 random.seed(0xF5DC)
 
