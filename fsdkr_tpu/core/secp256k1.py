@@ -185,6 +185,12 @@ class Point:
         k = scalar.v if isinstance(scalar, Scalar) else int(scalar) % N
         if k == 0 or self.infinity:
             return Point.identity()
+        if self.x == _GX and self.y == _GY:
+            # fixed-base comb for the generator: the protocol's host EC
+            # cost is dominated by G-multiples (commit-point fan-out, PDL
+            # u1, pk_vec interpolation, ECDSA) — the 64x16 nibble table
+            # replaces ~256 doublings + ~128 adds with <= 64 mixed adds
+            return _fixed_base_mul(k)
         # Jacobian double-and-add
         rx, ry, rz = 0, 1, 0  # identity in Jacobian (z=0)
         px, py, pz = self.x, self.y, 1
@@ -192,11 +198,7 @@ class Point:
             rx, ry, rz = _jdouble(rx, ry, rz)
             if bit == "1":
                 rx, ry, rz = _jadd(rx, ry, rz, px, py, pz)
-        if rz == 0:
-            return Point.identity()
-        zinv = _inv(rz, P)
-        z2 = (zinv * zinv) % P
-        return Point((rx * z2) % P, (ry * z2 % P) * zinv % P)
+        return _jac_to_affine(rx, ry, rz)
 
     __rmul__ = __mul__
 
@@ -245,6 +247,67 @@ def _jadd(x1, y1, z1, x2, y2, z2):
     y3 = (r * (v - x3) - 2 * s1 * j) % P
     z3 = (2 * h * z1 * z2) % P
     return x3, y3, z3
+
+
+# ---------------------------------------------------------------------------
+# Fixed-base comb table for the generator: T[w][d-1] = d * 2^(4w) * G in
+# affine, for 64 4-bit windows. Built lazily on the first G-multiple (~1024
+# Jacobian ops + one batched inversion chain, tens of ms, once per process).
+# Like the rest of this host oracle it is NOT constant-time — the oracle
+# trades side-channel hardening for auditability; see README security notes.
+
+_G_TABLE: list | None = None
+
+
+def _jac_to_affine(x, y, z) -> "Point":
+    """Jacobian (x, y, z) -> affine Point; the single conversion shared by
+    both scalar-mul paths (auditability: one place to get it right)."""
+    if z == 0:
+        return Point.identity()
+    zinv = _inv(z, P)
+    z2 = (zinv * zinv) % P
+    return Point((x * z2) % P, (y * z2 % P) * zinv % P)
+
+
+def _build_g_table():
+    rows = []  # Jacobian triples, 64 rows x 15 entries (d = 1..15)
+    bx, by, bz = _GX, _GY, 1  # B_w = 2^(4w) * G
+    for _ in range(64):
+        row = [(bx, by, bz)]
+        for _d in range(14):
+            row.append(_jadd(*row[-1], bx, by, bz))
+        rows.append(row)
+        for _s in range(4):
+            bx, by, bz = _jdouble(bx, by, bz)
+    # batch-normalize all 960 points to affine with one inversion chain
+    flat = [pt for row in rows for pt in row]
+    zs = [z for _, _, z in flat]
+    prefix = [1] * (len(zs) + 1)
+    for i, z in enumerate(zs):
+        prefix[i + 1] = prefix[i] * z % P
+    acc = _inv(prefix[-1], P)
+    zinvs = [0] * len(zs)
+    for i in range(len(zs) - 1, -1, -1):
+        zinvs[i] = prefix[i] * acc % P
+        acc = acc * zs[i] % P
+    affine = []
+    for (x, y, _z), zi in zip(flat, zinvs):
+        z2 = zi * zi % P
+        affine.append((x * z2 % P, y * z2 % P * zi % P))
+    return [affine[w * 15 : (w + 1) * 15] for w in range(64)]
+
+
+def _fixed_base_mul(k: int) -> "Point":
+    global _G_TABLE
+    if _G_TABLE is None:
+        _G_TABLE = _build_g_table()
+    rx, ry, rz = 0, 1, 0
+    for w in range(64):
+        d = (k >> (4 * w)) & 0xF
+        if d:
+            ax, ay = _G_TABLE[w][d - 1]
+            rx, ry, rz = _jadd(rx, ry, rz, ax, ay, 1)
+    return _jac_to_affine(rx, ry, rz)
 
 
 GENERATOR = Point(_GX, _GY)
