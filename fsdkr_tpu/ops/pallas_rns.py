@@ -71,8 +71,12 @@ def _matmul_mod(x, lo, hi, mods, u16m):
     (R, C) sums mod per-column modulus. The contraction is chunked at
     _LANE terms so every f32-accumulated dot stays exact (static Python
     loop — shapes are compile-time constants inside the kernel)."""
-    xl = (x & jnp.uint32(0xFF)).astype(jnp.bfloat16)
-    xh = (x >> 8).astype(jnp.bfloat16)
+    # Mosaic has no unsigned<->float casts: route u32->i32->f32->bf16
+    # (and f32->i32->u32 on the way back); all values are < 2^31 so the
+    # signed detour is exact
+    xl = (x & jnp.uint32(0xFF)).astype(jnp.int32).astype(jnp.float32)
+    xl = xl.astype(jnp.bfloat16)
+    xh = (x >> 8).astype(jnp.int32).astype(jnp.float32).astype(jnp.bfloat16)
     dot = functools.partial(
         jnp.dot,
         precision=jax.lax.Precision.HIGHEST,
@@ -82,10 +86,10 @@ def _matmul_mod(x, lo, hi, mods, u16m):
     out = None
     for s in range(0, k, _LANE):
         e = min(s + _LANE, k)
-        pll = dot(xl[:, s:e], lo[s:e]).astype(_U32)
-        plh = dot(xl[:, s:e], hi[s:e]).astype(_U32)
-        phl = dot(xh[:, s:e], lo[s:e]).astype(_U32)
-        phh = dot(xh[:, s:e], hi[s:e]).astype(_U32)
+        pll = dot(xl[:, s:e], lo[s:e]).astype(jnp.int32).astype(_U32)
+        plh = dot(xl[:, s:e], hi[s:e]).astype(jnp.int32).astype(_U32)
+        phl = dot(xh[:, s:e], lo[s:e]).astype(jnp.int32).astype(_U32)
+        phh = dot(xh[:, s:e], hi[s:e]).astype(jnp.int32).astype(_U32)
         # combine pll + 2^8(plh+phl) + 2^16 phh with interleaved folds;
         # all intermediates stay < 2^31 for <=128-term chunks
         # (u16m <= 8536)
