@@ -41,7 +41,14 @@ run() {  # name, timeout, [VAR=V ...] cmd args...   (no '--': env treats
   echo "=== $name ($(date +%H:%M:%S)) ==="
   timeout "$to" env "$@" > "$R/m_$name.json" 2> "$R/m_$name.log"
   rc=$?
-  if [ "$rc" = 0 ]; then touch "$R/m_$name.ok"; else mv "$R/m_$name.json" "$R/m_$name.json.failed"; fi
+  # bench.py exits 0 even when it degrades to an annotated error line, so
+  # rc alone is not "measured" — an "error" key in the JSON is a failure
+  if [ "$rc" = 0 ] && ! grep -q '"error"' "$R/m_$name.json"; then
+    touch "$R/m_$name.ok"
+  else
+    mv "$R/m_$name.json" "$R/m_$name.json.failed"
+    [ "$rc" = 0 ] && rc=error-in-json
+  fi
   echo "rc=$rc tail:"; tail -3 "$R/m_$name.log"; cat "$R/m_$name.json" 2>/dev/null
 }
 
