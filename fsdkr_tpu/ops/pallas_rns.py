@@ -295,10 +295,15 @@ def _modexp_kernel_pallas(
         w = (limb >> (shift % LIMB_BITS)) & jnp.uint32((1 << WINDOW_BITS) - 1)
         for _ in range(WINDOW_BITS):
             acc = mul(acc, acc)
+        # Mosaic has no unsigned reductions: sum the masked table in
+        # int32 (residues are 16-bit, and 15 of the 16 terms are zero,
+        # so the signed detour is exact; the u32<->i32 hops are free)
         sel = jnp.sum(
-            jnp.where(w[None, :, :] == idx, table_ref[:], jnp.uint32(0)),
+            jnp.where(
+                w[None, :, :] == idx, table_ref[:], jnp.uint32(0)
+            ).astype(jnp.int32),
             axis=0,
-        )
+        ).astype(_U32)
         return mul(acc, sel)
 
     acc = jax.lax.fori_loop(0, exp_bits // WINDOW_BITS, step, one_m)

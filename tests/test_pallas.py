@@ -154,84 +154,6 @@ class TestPallasMontMul:
         )
         assert (got == want).all()
 
-    def test_kernels_mosaic_lowerable(self, bases_512):
-        """No unsigned<->float casts anywhere in the kernel jaxprs.
-
-        Mosaic (the Pallas TPU compiler) cannot lower uint<->float
-        conversions in either direction; interpret mode accepts them, so
-        a kernel can pass the whole CPU suite and still fail to compile
-        at first contact with hardware (that is exactly how the round-5
-        n16 bench died). This audit traces both kernels and walks every
-        nested jaxpr so the class of failure is caught on CPU.
-        """
-        from jax import make_jaxpr
-
-        from fsdkr_tpu.ops.pallas_rns import (
-            rns_modexp_pallas,
-            rns_mont_mul_pallas,
-        )
-
-        rb = bases_512
-        rows, k = 8, rb.k
-        moduli, c1, n_bmr = _row_setup(rb, rows)
-        x = _to_residues([secrets.randbelow(n) for n in moduli], rb)
-        y = _to_residues([secrets.randbelow(n) for n in moduli], rb)
-        shared = rns._pallas_shared(_consts_arrays(rb))
-        exp = jnp.zeros((rows, 4), jnp.uint32)
-
-        traced = [
-            make_jaxpr(
-                lambda a, b: rns_mont_mul_pallas(
-                    a, b, c1, n_bmr, shared, k=k, interpret=True
-                )
-            )(x, y),
-            make_jaxpr(
-                lambda a, e, b: rns_modexp_pallas(
-                    a, e, b, c1, n_bmr, shared,
-                    exp_bits=64, k=k, interpret=True,
-                )
-            )(x, exp, y),
-        ]
-
-        bad = []
-        seen_converts = [0]
-
-        def walk(jaxpr):
-            for eqn in jaxpr.eqns:
-                if eqn.primitive.name == "convert_element_type":
-                    seen_converts[0] += 1
-                    f = eqn.invars[0].aval.dtype
-                    t = eqn.params["new_dtype"]
-                    uns = jnp.issubdtype(f, jnp.unsignedinteger) or (
-                        jnp.issubdtype(t, jnp.unsignedinteger)
-                    )
-                    flt = jnp.issubdtype(f, jnp.floating) or (
-                        jnp.issubdtype(t, jnp.floating)
-                    )
-                    if uns and flt:
-                        bad.append((str(f), str(t)))
-                for sub in eqn.params.values():
-                    # sub-jaxprs hide under several shapes: a raw Jaxpr
-                    # (pallas_call's `jaxpr` param), a ClosedJaxpr
-                    # (.jaxpr), or a tuple of either (cond/switch
-                    # `branches`)
-                    items = (
-                        sub if isinstance(sub, (tuple, list)) else (sub,)
-                    )
-                    for item in items:
-                        inner = (
-                            item.jaxpr if hasattr(item, "jaxpr") else item
-                        )
-                        if hasattr(inner, "eqns"):
-                            walk(inner)
-
-        for jx in traced:
-            walk(jx.jaxpr)
-        # the audit must actually have reached the kernel bodies: the
-        # 8-bit-split matmul alone converts to bf16 and back many times
-        assert seen_converts[0] >= 8, "jaxpr walk never reached the kernel"
-        assert not bad, f"Mosaic-unlowerable casts in kernel: {bad}"
-
     def test_full_modexp_pallas_forced(self, bases_512, monkeypatch):
         """rns_modexp with FSDKR_PALLAS=1 (interpret off-TPU) vs pow."""
         monkeypatch.setenv("FSDKR_PALLAS", "1")

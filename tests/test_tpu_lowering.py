@@ -1,0 +1,186 @@
+"""AOT TPU-lowerability audit for every device kernel family.
+
+Interpret mode and the XLA:CPU backend accept programs that Mosaic (the
+Pallas TPU compiler) and the TPU lowering rules reject — the round-5 n16
+bench died on chip with `Unsupported cast: uint32 -> bfloat16` after the
+entire CPU suite passed. JAX's AOT path compiles for a platform without
+owning a device: `jax.jit(f).trace(*args).lower(lowering_platforms=
+("tpu",))` runs the full StableHLO + Mosaic kernel lowering on the CPU
+host and raises exactly where a real chip compile would (verified: the
+reverted cast bug reproduces under this harness).
+
+Mechanism: run each public entry point on CPU at tiny shapes while
+recording the concrete (args, kwargs) of its inner jitted kernel, then
+re-lower every recorded call for platform "tpu". This keeps the audit in
+lockstep with production routing — whatever the entry point launches is
+what gets lowered.
+
+Limits: lowering stops short of the Mosaic *backend* (register
+allocation, VMEM budgeting), so out-of-VMEM failures still need the real
+chip; everything at the lowering layer (unsupported casts, primitives,
+layouts) is caught here.
+"""
+
+import contextlib
+import secrets
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fsdkr_tpu.ops import ec_batch, montgomery, pallas_rns, rns
+from fsdkr_tpu.ops.limbs import limbs_for_bits
+
+BITS = 512
+
+
+class _CaptureStop(Exception):
+    """Raised by the recorder once the kernel call is captured — the
+    drivers' results are discarded, so executing the kernel on CPU and
+    the host post-processing after it would be pure waste."""
+
+
+@contextlib.contextmanager
+def capture_calls(module, name, into):
+    """Swap module.<name> for a recorder that stores (fn, args, kwargs)
+    of the first call and aborts the driver via _CaptureStop."""
+    orig = getattr(module, name)
+
+    def recorder(*args, **kwargs):
+        into.append((orig, args, kwargs))
+        raise _CaptureStop
+
+    setattr(module, name, recorder)
+    try:
+        with contextlib.suppress(_CaptureStop):
+            yield
+    finally:
+        setattr(module, name, orig)
+
+
+def lower_for_tpu(fn, args, kwargs):
+    """AOT-lower one recorded kernel call for platform `tpu`."""
+    kwargs = dict(kwargs)
+    # interpret mode bypasses Mosaic entirely; force the real TPU path
+    if "interpret" in kwargs:
+        kwargs["interpret"] = False
+    lowered = fn.trace(*args, **kwargs).lower(lowering_platforms=("tpu",))
+    return lowered.as_text()
+
+
+def _modexp_workload(rows):
+    moduli = [
+        secrets.randbits(BITS) | (1 << (BITS - 1)) | 1 for _ in range(rows)
+    ]
+    bases = [secrets.randbelow(n) for n in moduli]
+    exps = [secrets.randbits(64) for _ in range(rows)]
+    return bases, exps, moduli
+
+
+class TestKernelsLowerForTpu:
+    def test_rns_xla_chain(self, monkeypatch):
+        monkeypatch.setenv("FSDKR_PALLAS", "0")
+        bases, exps, moduli = _modexp_workload(8)
+        calls = []
+        with capture_calls(rns, "_rns_modexp_kernel", calls):
+            rns.rns_modexp(bases, exps, moduli, BITS)
+        assert calls, "driver never reached the kernel"
+        for fn, args, kwargs in calls:
+            lower_for_tpu(fn, args, kwargs)
+
+    def test_rns_pallas_fused(self, monkeypatch):
+        monkeypatch.setenv("FSDKR_PALLAS", "1")
+        bases, exps, moduli = _modexp_workload(8)
+        calls = []
+        with capture_calls(pallas_rns, "rns_modexp_pallas", calls):
+            rns.rns_modexp(bases, exps, moduli, BITS)
+        assert calls, "driver never reached the Pallas kernel"
+        for fn, args, kwargs in calls:
+            text = lower_for_tpu(fn, args, kwargs)
+            assert "tpu_custom_call" in text  # Mosaic kernel actually ran
+
+    def test_rns_mont_mul_pallas(self):
+        rb = rns.rns_bases_for_bits(BITS, limbs_for_bits(BITS))
+        rows, k = 8, rb.k
+        x = jnp.asarray(
+            np.array([[i % int(m) for m in rb.m_all] for i in range(2, rows + 2)],
+                     np.uint32)
+        )
+        c1 = jnp.zeros((rows, k), jnp.uint32)
+        nbmr = jnp.ones((rows, k + 1), jnp.uint32)
+        shared = rns._pallas_shared(rns._prep_consts(rb))
+        text = lower_for_tpu(
+            pallas_rns.rns_mont_mul_pallas,
+            (x, x, c1, nbmr, shared),
+            dict(k=k, interpret=False),
+        )
+        assert "tpu_custom_call" in text
+
+    def test_rns_shared_comb(self, monkeypatch):
+        monkeypatch.setenv("FSDKR_PALLAS", "0")
+        gmods = [
+            secrets.randbits(BITS) | (1 << (BITS - 1)) | 1 for _ in range(2)
+        ]
+        gbases = [secrets.randbelow(n) for n in gmods]
+        gexps = [[secrets.randbits(64) for _ in range(4)] for _ in gmods]
+        calls = []
+        with capture_calls(rns, "_rns_shared_modexp_kernel", calls):
+            rns.rns_modexp_shared(gbases, gexps, gmods, BITS)
+        assert calls, "driver never reached the comb kernel"
+        for fn, args, kwargs in calls:
+            lower_for_tpu(fn, args, kwargs)
+
+    def test_cios_generic(self):
+        bases, exps, moduli = _modexp_workload(8)
+        ctx = montgomery.BatchModExp(moduli, limbs_for_bits(BITS))
+        calls = []
+        with capture_calls(montgomery, "_modexp_kernel", calls):
+            ctx.modexp(bases, exps)
+        assert calls, "driver never reached the CIOS kernel"
+        for fn, args, kwargs in calls:
+            lower_for_tpu(fn, args, kwargs)
+
+    def test_cios_shared_comb(self):
+        gmods = [
+            secrets.randbits(BITS) | (1 << (BITS - 1)) | 1 for _ in range(2)
+        ]
+        gbases = [secrets.randbelow(n) for n in gmods]
+        gexps = [[secrets.randbits(64) for _ in range(4)] for _ in gmods]
+        calls = []
+        with capture_calls(montgomery, "_shared_modexp_kernel", calls):
+            montgomery.shared_base_modexp(
+                gbases, gexps, gmods, limbs_for_bits(BITS)
+            )
+        assert calls, "driver never reached the shared CIOS kernel"
+        for fn, args, kwargs in calls:
+            lower_for_tpu(fn, args, kwargs)
+
+    def test_ec_batch(self):
+        from fsdkr_tpu.core import secp256k1 as ec
+
+        pts = [ec.GENERATOR * (i + 2) for i in range(4)]
+        scalars = [secrets.randbelow(ec.CURVE_ORDER) for _ in range(4)]
+        calls = []
+        with capture_calls(ec_batch, "_scalar_mul_kernel", calls):
+            ec_batch.batch_scalar_mul(pts, scalars)
+        assert calls, "driver never reached the EC kernel"
+        for fn, args, kwargs in calls:
+            lower_for_tpu(fn, args, kwargs)
+
+
+class TestEntryLowersForTpu:
+    def test_graft_entry(self):
+        """The driver compile-checks entry() on the real chip; pre-flight
+        the same compile here so a lowering break is caught on CPU."""
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "_graft_entry",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "__graft_entry__.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        fn, example_args = mod.entry()
+        jax.jit(fn).trace(*example_args).lower(lowering_platforms=("tpu",))
