@@ -1,0 +1,99 @@
+"""AOT TPU compile-checking without a device.
+
+JAX's ahead-of-time API lowers a jitted function for any platform on the
+host: `fn.trace(*args).lower(lowering_platforms=("tpu",))` runs the full
+StableHLO pipeline *including the Mosaic Pallas-kernel lowering* and
+raises exactly where a real chip compile would. Interpret mode and the
+XLA:CPU backend accept programs Mosaic rejects (unsigned<->float casts,
+unsigned reductions, ...), so this is the only way to catch that class
+in a chipless environment — both round-5 hardware-only compile failures
+reproduce under it.
+
+Used by tests/test_tpu_lowering.py (per-kernel audit) and
+scripts/preflight_tpu.py (whole-protocol capture sweep before burning
+tunnel time on a bench run).
+
+Limits: lowering stops short of the Mosaic backend (register allocation,
+VMEM budgeting), so out-of-memory failures still need the chip.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax
+
+__all__ = [
+    "abstractify",
+    "lower_for_tpu",
+    "jitted_functions",
+    "capture_jitted",
+]
+
+
+def abstractify(tree: Any) -> Any:
+    """Replace every array-like leaf (incl. live tracers) with a
+    ShapeDtypeStruct so captured calls can be re-lowered after the trace
+    that produced them is gone."""
+
+    def leaf(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return x
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def lower_for_tpu(fn: Callable, args: Tuple, kwargs: Dict) -> str:
+    """AOT-lower one (possibly captured) call for platform `tpu`."""
+    kwargs = dict(kwargs)
+    # interpret mode bypasses Mosaic entirely; force the real TPU path.
+    # pallas_mode follows the same convention (2 = interpret, 1 = real):
+    # calls captured on the CPU host carry mode 2 and must be promoted,
+    # or the fused path would lower without ever reaching Mosaic.
+    if "interpret" in kwargs:
+        kwargs["interpret"] = False
+    if kwargs.get("pallas_mode") == 2:
+        kwargs["pallas_mode"] = 1
+    args, kwargs = abstractify((args, kwargs))
+    lowered = fn.trace(*args, **kwargs).lower(lowering_platforms=("tpu",))
+    return lowered.as_text()
+
+
+def jitted_functions(module) -> List[str]:
+    """Names of module-level jitted callables (the AOT `Wrapped` API)."""
+    out = []
+    for name, val in vars(module).items():
+        if callable(val) and hasattr(val, "trace") and hasattr(val, "lower"):
+            out.append(name)
+    return sorted(out)
+
+
+@contextlib.contextmanager
+def capture_jitted(modules, into: List):
+    """Wrap every jitted function in `modules` with a delegating recorder.
+
+    Each call appends (qualname, fn, abstract_args, abstract_kwargs) to
+    `into` — abstracted immediately, so recording calls that happen
+    inside an enclosing jit trace (tracer arguments) stays legal after
+    that trace ends — then runs the original so the driver proceeds.
+    """
+    saved = []
+    try:
+        for module in modules:
+            for name in jitted_functions(module):
+                orig = getattr(module, name)
+                saved.append((module, name, orig))
+
+                def recorder(*args, _orig=orig, _mod=module, _name=name,
+                             **kwargs):
+                    a, kw = abstractify((args, kwargs))
+                    into.append((f"{_mod.__name__}.{_name}", _orig, a, kw))
+                    return _orig(*args, **kwargs)
+
+                setattr(module, name, recorder)
+        yield
+    finally:
+        for module, name, orig in saved:
+            setattr(module, name, orig)

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Pre-flight the whole protocol's TPU compile surface — no chip needed.
+
+Runs a tiny full refresh round (keygen -> distribute -> collect) on the
+CPU platform with device EC forced on, once per Pallas mode, while
+recording every jitted call the protocol actually makes (via
+fsdkr_tpu.utils.aot_check.capture_jitted over every kernel-bearing
+module). Each distinct (function, shapes) call is then AOT-lowered for
+platform "tpu".
+
+Run this before spending tunnel time on a bench: a kernel that cannot
+lower dies here in seconds instead of inside the first on-chip bench
+step (which is how round 5 lost its first tunnel window).
+
+Exit status: 0 = every captured call lowers for TPU; 1 = failures
+(listed on stderr, one JSON line each on stdout).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run_tiny_refresh(pallas_mode: str):
+    """One n=4 refresh at TEST_CONFIG size; returns captured calls."""
+    os.environ["FSDKR_PALLAS"] = pallas_mode
+    os.environ["FSDKR_DEVICE_EC"] = "1"  # the TPU-platform routing
+    # force the batched-device columns even at tiny row counts so the
+    # RNS/comb kernels are reached the way a full-size collect reaches them
+    os.environ.setdefault("FSDKR_RNS_MIN_ROWS", "1")
+
+    from fsdkr_tpu.config import TEST_CONFIG
+    from fsdkr_tpu.ops import ec_batch, montgomery, pallas_rns, rns
+    from fsdkr_tpu.parallel import shard_kernels, sharded_verify
+    from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+    from fsdkr_tpu.utils.aot_check import capture_jitted
+
+    # the batched device path, exactly as a TPU-platform session routes it
+    cfg = TEST_CONFIG.with_backend("tpu")
+
+    modules = [
+        ec_batch, montgomery, pallas_rns, rns, shard_kernels, sharded_verify,
+    ]
+    calls = []
+    n, t = 4, 1
+    with capture_jitted(modules, calls):
+        keys = simulate_keygen(t, n, cfg)
+        results = [RefreshMessage.distribute(k.i, k, n, cfg) for k in keys]
+        msgs = [m for m, _ in results]
+        # one collect exercises the full verify surface; the other
+        # parties' collects would capture identical geometry
+        RefreshMessage.collect(msgs, keys[0], results[0][1], [], cfg)
+    return calls
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from fsdkr_tpu.utils.aot_check import lower_for_tpu
+
+    all_calls = []
+    for mode in ("0", "1"):
+        log(f"--- capture pass: FSDKR_PALLAS={mode}")
+        calls = run_tiny_refresh(mode)
+        log(f"    {len(calls)} jitted calls recorded")
+        all_calls.extend(calls)
+
+    # dedup by (name, full signature): one lowering per distinct geometry
+    # AND static configuration — scalar kwargs like pallas_mode or
+    # exp_bits select different kernel bodies, so they must stay in the
+    # key (an array leaf contributes its aval, anything else its repr)
+    def leaf_sig(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return (tuple(x.shape), str(x.dtype))
+        return repr(x)
+
+    seen = {}
+    for name, fn, args, kwargs in all_calls:
+        key = (name, str(jax.tree_util.tree_structure((args, kwargs))),
+               str(jax.tree_util.tree_map(leaf_sig, (args, kwargs))))
+        seen.setdefault(key, (name, fn, args, kwargs))
+
+    log(f"--- lowering {len(seen)} distinct calls for platform tpu")
+    failures = 0
+    for name, fn, args, kwargs in seen.values():
+        try:
+            text = lower_for_tpu(fn, args, kwargs)
+            rec = {"kernel": name, "ok": True,
+                   "mosaic": "tpu_custom_call" in text}
+        except Exception as e:
+            failures += 1
+            rec = {"kernel": name, "ok": False,
+                   "error": f"{type(e).__name__}: {str(e)[:300]}"}
+            log(f"FAIL {name}: {rec['error']}")
+        print(json.dumps(rec), flush=True)
+
+    log(f"--- preflight {'FAILED' if failures else 'ok'}: "
+        f"{len(seen) - failures}/{len(seen)} lowered")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

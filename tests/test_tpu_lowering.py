@@ -30,6 +30,7 @@ import numpy as np
 
 from fsdkr_tpu.ops import ec_batch, montgomery, pallas_rns, rns
 from fsdkr_tpu.ops.limbs import limbs_for_bits
+from fsdkr_tpu.utils.aot_check import lower_for_tpu
 
 BITS = 512
 
@@ -56,16 +57,6 @@ def capture_calls(module, name, into):
             yield
     finally:
         setattr(module, name, orig)
-
-
-def lower_for_tpu(fn, args, kwargs):
-    """AOT-lower one recorded kernel call for platform `tpu`."""
-    kwargs = dict(kwargs)
-    # interpret mode bypasses Mosaic entirely; force the real TPU path
-    if "interpret" in kwargs:
-        kwargs["interpret"] = False
-    lowered = fn.trace(*args, **kwargs).lower(lowering_platforms=("tpu",))
-    return lowered.as_text()
 
 
 def _modexp_workload(rows):
