@@ -92,6 +92,10 @@ def capture_jitted(modules, into: List):
                     into.append((f"{_mod.__name__}.{_name}", _orig, a, kw))
                     return _orig(*args, **kwargs)
 
+                # the sharded wrappers unwrap the jit to re-wrap it in
+                # shard_map (`_modexp_kernel.__wrapped__`); keep that
+                # working while the recorder is installed
+                recorder.__wrapped__ = getattr(orig, "__wrapped__", orig)
                 setattr(module, name, recorder)
         yield
     finally:

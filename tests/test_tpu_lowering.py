@@ -159,6 +159,37 @@ class TestKernelsLowerForTpu:
             lower_for_tpu(fn, args, kwargs)
 
 
+class TestProductionGeometryLowers:
+    """The capture sweep runs at TEST_CONFIG size (768-bit); the bench
+    compiles the same kernels at 2048-bit (mod N, k=131) and 4096-bit
+    (mod N^2, k=260 — past the single-chunk matmul bound) with larger
+    row tiles. Lower the fused kernel at bench geometry via abstract
+    ShapeDtypeStruct rows — no data, just the real compile problem."""
+
+    def _lower(self, bits, rows, exp_bits):
+        rb = rns.rns_bases_for_bits(bits, limbs_for_bits(bits))
+        k = rb.k
+        C = 2 * k + 1
+        shared = rns._pallas_shared(rns._prep_consts(rb))
+        sds = jax.ShapeDtypeStruct
+        res = sds((rows, C), jnp.uint32)
+        exp = sds((rows, -(-exp_bits // 16)), jnp.uint32)
+        c1 = sds((rows, k), jnp.uint32)
+        nbmr = sds((rows, k + 1), jnp.uint32)
+        text = lower_for_tpu(
+            pallas_rns.rns_modexp_pallas,
+            (res, exp, res, c1, nbmr, shared),
+            dict(exp_bits=exp_bits, k=k, interpret=False),
+        )
+        assert "tpu_custom_call" in text
+
+    def test_2048bit_full_exponent(self):
+        self._lower(2048, 1024, 2048)
+
+    def test_4096bit_full_exponent(self):
+        self._lower(4096, 512, 4096)
+
+
 class TestEntryLowersForTpu:
     def test_graft_entry(self):
         """The driver compile-checks entry() on the real chip; pre-flight
