@@ -279,6 +279,7 @@ def bench_sessions(sessions_count, n, t, bits, m_sec):
             "collect_cold_s": round(t_cold, 2),
             "sessions": sessions_count,
             "device_ec": tpu_cfg.device_ec,
+            "device_powm": tpu_cfg.device_powm,
             "mesh": mesh_shape,
             **roofline_fields(t_warm),
         }
@@ -352,6 +353,7 @@ def bench_join(n, t, bits, m_sec, joins):
             "collect_cold_s": round(t_cold, 2),
             "replace_s": round(t_replace, 2),
             "device_ec": tpu_cfg.device_ec,
+            "device_powm": tpu_cfg.device_powm,
             **roofline_fields(t_warm),
         }
     )
@@ -530,9 +532,10 @@ def main():
         # vs_baseline is only "vs native C++" when the core actually loaded;
         # otherwise both baselines are CPython and this flags it
         "host_native_available": native.available(),
-        # which route the EC hot paths took (config.device_ec: auto-
-        # routed by platform, forceable via FSDKR_DEVICE_EC)
+        # which routes the hot paths took (auto-routed by platform,
+        # forceable via FSDKR_DEVICE_EC / FSDKR_DEVICE_POWM)
         "device_ec": tpu_cfg.device_ec,
+        "device_powm": tpu_cfg.device_powm,
         "collect_warm_s": round(t_tpu, 2),
         "collect_cold_s": round(t_tpu_cold, 2),
         "compile_overhead_s": round(t_tpu_cold - t_tpu, 2),

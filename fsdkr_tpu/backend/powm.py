@@ -95,6 +95,8 @@ def tpu_modmul(a, b, moduli) -> List[int]:
     """Row-wise a*b mod moduli as one padded multi-modulus launch."""
     if not a:
         return []
+    if not _device_powm():  # CPU fallback: a bigint mulmod is pure C
+        return [(x * y) % m for x, y, m in zip(a, b, moduli)]
     from ..ops.limbs import limbs_for_bits
     from ..utils.roofline import modmul_macs
     from ..utils.trace import get_tracer
@@ -117,6 +119,17 @@ import os as _os
 
 _RNS_MIN_ROWS = int(_os.environ.get("FSDKR_RNS_MIN_ROWS", "512"))
 
+
+def _device_powm() -> bool:
+    """config.device_powm's routing, sans the backend gate — these
+    helpers are only reachable from the tpu backend (get_batch_powm
+    returns host_powm for backend="host"). The tests force =1
+    (tests/conftest.py) to keep kernel coverage; auto routes host on
+    XLA:CPU, where the native C++ core beats the batched kernels."""
+    from ..config import _route_device
+
+    return _route_device("FSDKR_DEVICE_POWM")
+
 # HBM ceiling: the modexp kernels materialize a 16-entry window table
 # over the whole batch (generic: 16*R rows; comb: 16*W*G rows with
 # W = exp_bits/4 windows). At the n=256 collect shape an unchunked
@@ -133,6 +146,8 @@ _RNS_WIDTH_CLASSES = (256, 512, 1024, 1536, 2048, 3072, 4096)
 def tpu_powm(bases, exps, moduli) -> List[int]:
     if not bases:
         return []
+    if not _device_powm():  # CPU fallback: native C++ Montgomery core
+        return host_powm(bases, exps, moduli)
     if len(bases) > _MAX_ROWS:  # HBM tiling: sequential launches
         out: List[int] = []
         for lo in range(0, len(bases), _MAX_ROWS):
@@ -179,6 +194,11 @@ def tpu_powm_shared(bases, exps_per_group, moduli) -> List[List[int]]:
 
     if not bases:
         return []
+    if not _device_powm():  # CPU fallback: native core, one batch/group
+        return [
+            host_powm([b] * len(es), es, [m] * len(es)) if es else []
+            for b, es, m in zip(bases, exps_per_group, moduli)
+        ]
     w_cnt = max(
         1,
         bucket_exp_bits(e for grp in exps_per_group for e in grp)

@@ -39,6 +39,18 @@ def _accelerator_present() -> bool:
     return _accel_probe
 
 
+def _route_device(env_var: str) -> bool:
+    """Shared device/host routing token table: `0/off/false/no` forces
+    host, `1/on/true/yes` forces device, anything else (auto) picks the
+    device only when a real accelerator is behind JAX."""
+    env = os.environ.get(env_var, "auto").lower()
+    if env in ("0", "off", "false", "no"):
+        return False
+    if env in ("1", "on", "true", "yes"):
+        return True
+    return _accelerator_present()
+
+
 @dataclass(frozen=True)
 class ProtocolConfig:
     """All security / execution parameters of the refresh protocol.
@@ -118,12 +130,19 @@ class ProtocolConfig:
         protocol shape (bench_results/ec_ab_cpu.json)."""
         if self.backend != "tpu":
             return False
-        env = os.environ.get("FSDKR_DEVICE_EC", "auto").lower()
-        if env in ("0", "off", "false", "no"):
+        return _route_device("FSDKR_DEVICE_EC")
+
+    @property
+    def device_powm(self) -> bool:
+        """Whether batched modexp/modmul launches ride the JAX device
+        kernels (same contract as device_ec: forceable via
+        FSDKR_DEVICE_POWM, auto picks the device only behind a real
+        accelerator — on XLA:CPU the native C++ Montgomery core wins;
+        modexp columns are ~70% of a warm fallback collect,
+        bench_results/cpu_scale_n64_r5b.json)."""
+        if self.backend != "tpu":
             return False
-        if env in ("1", "on", "true", "yes"):
-            return True
-        return _accelerator_present()
+        return _route_device("FSDKR_DEVICE_POWM")
 
     @property
     def prime_bits(self) -> int:

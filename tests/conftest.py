@@ -22,10 +22,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
-# The suite runs on the CPU platform, where auto EC routing would send
-# every EC path to the host oracle (fsdkr_tpu.config.device_ec) — force
-# the device route so the batched EC kernels keep integration coverage.
+# The suite runs on the CPU platform, where auto EC and modexp routing
+# would send every hot path to the host oracle (fsdkr_tpu.config
+# device_ec, backend.powm._device_powm) — force the device routes so the
+# batched kernels keep integration coverage.
 os.environ.setdefault("FSDKR_DEVICE_EC", "1")
+os.environ.setdefault("FSDKR_DEVICE_POWM", "1")
 
 import pytest  # noqa: E402
 

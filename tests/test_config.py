@@ -69,3 +69,49 @@ class TestWipeHelpers:
         assert any(buf)
         native._wipe_buf(buf)
         assert not any(buf)
+
+
+class TestDevicePowmRouting:
+    """backend.powm._device_powm mirrors the device_ec contract and the
+    host fallbacks must agree with the CPython oracle."""
+
+    def test_env_forces_route(self, monkeypatch):
+        from fsdkr_tpu.backend import powm
+
+        monkeypatch.setenv("FSDKR_DEVICE_POWM", "0")
+        assert powm._device_powm() is False
+        monkeypatch.setenv("FSDKR_DEVICE_POWM", "1")
+        assert powm._device_powm() is True
+
+    def test_auto_routes_host_on_cpu_platform(self, monkeypatch):
+        from fsdkr_tpu.backend import powm
+
+        monkeypatch.setenv("FSDKR_DEVICE_POWM", "auto")
+        assert powm._device_powm() is False
+
+    def test_host_route_matches_oracle(self, monkeypatch):
+        """Forced-host tpu_powm / tpu_powm_shared / tpu_modmul must equal
+        pow; and the device path must never be entered (the launch would
+        be the bug — this is the route under test, not the kernels)."""
+        from fsdkr_tpu.backend import powm
+
+        monkeypatch.setenv("FSDKR_DEVICE_POWM", "0")
+
+        def boom(*a, **k):  # device entry = routing failure
+            raise AssertionError("device launch on forced-host route")
+
+        monkeypatch.setattr(powm, "_cached_ctx", boom)
+        mods = [(1 << 255) | 199, (1 << 255) | 321]
+        bases = [123456789, 987654321]
+        exps = [(1 << 64) | 7, (1 << 64) | 9]
+        assert powm.tpu_powm(bases, exps, mods) == [
+            pow(b, e, m) for b, e, m in zip(bases, exps, mods)
+        ]
+        assert powm.tpu_modmul(bases, exps, mods) == [
+            (b * e) % m for b, e, m in zip(bases, exps, mods)
+        ]
+        grouped = powm.tpu_powm_shared(bases, [exps, exps[:1]], mods)
+        assert grouped == [
+            [pow(bases[0], e, mods[0]) for e in exps],
+            [pow(bases[1], exps[0], mods[1])],
+        ]
