@@ -206,6 +206,31 @@ class TpuBatchVerifier(BatchVerifier):
 
     @staticmethod
     def _pdl_u1_host(items, e_vec) -> List[bool]:
+        from ..native import ec as native_ec
+
+        if native_ec.available() and items:
+            # one native launch: u1 ?= s1*G + (q - e)*Q per row
+            evals = native_ec.lincomb2_batch(
+                [None if st.G.infinity else (st.G.x, st.G.y)
+                 for _, st in items],
+                [p.s1 % CURVE_ORDER for p, _ in items],
+                [None if st.Q.infinity else (st.Q.x, st.Q.y)
+                 for _, st in items],
+                [(CURVE_ORDER - e % CURVE_ORDER) % CURVE_ORDER
+                 for e in e_vec],
+            )
+            if evals is not None:
+                out = []
+                for (proof, _), ev in zip(items, evals):
+                    if ev is None:
+                        out.append(proof.u1.infinity)
+                    else:
+                        out.append(
+                            (not proof.u1.infinity)
+                            and proof.u1.x == ev[0]
+                            and proof.u1.y == ev[1]
+                        )
+                return out
         out = []
         for idx, (proof, st) in enumerate(items):
             g_s1 = st.G * Scalar.from_int(proof.s1)

@@ -13,14 +13,11 @@ implementation when the toolchain is unavailable.
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
-import platform
 import secrets
-import subprocess
-import tempfile
-import threading
 from typing import List, Optional, Sequence
+
+from . import _loader
 
 __all__ = [
     "available",
@@ -33,74 +30,19 @@ _LIMB_BYTES = 8
 _MAX_LIMBS = 64  # 4096 bits, keep in sync with MAXL in csrc
 _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc", "fsdkr_native.cpp")
 
-_lib: Optional[ctypes.CDLL] = None
-_tried = False
-_lock = threading.Lock()
-
-
-def _so_path(src: str) -> str:
-    """Cache path tagged by source hash + machine arch: a stale or
-    cross-arch artifact (e.g. copied checkout, -march=native on a
-    different host) can never be picked up."""
-    with open(src, "rb") as f:
-        tag = hashlib.sha256(f.read()).hexdigest()[:16]
-    return os.path.join(
-        os.path.dirname(__file__), f"_fsdkr_native_{tag}_{platform.machine()}.so"
-    )
-
-
-def _build() -> Optional[ctypes.CDLL]:
-    src = os.path.abspath(_SRC)
-    if not os.path.exists(src):
-        return None
-    so = _so_path(src)
-    if not os.path.exists(so):
-        fd, tmp = tempfile.mkstemp(
-            suffix=".so", prefix="_fsdkr_build_", dir=os.path.dirname(so)
-        )
-        os.close(fd)
-        cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", tmp, src]
-        try:
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            os.replace(tmp, so)
-        except (subprocess.SubprocessError, OSError):
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            return None
-        # prune artifacts from older source revisions / other arches
-        here = os.path.dirname(so)
-        for name in os.listdir(here):
-            if name.startswith("_fsdkr_native") and name.endswith(".so"):
-                path = os.path.join(here, name)
-                if path != so:
-                    try:
-                        os.unlink(path)
-                    except OSError:
-                        pass
-    try:
-        lib = ctypes.CDLL(so)
-    except OSError:
-        return None
-    lib.fsdkr_modexp.restype = ctypes.c_int
-    lib.fsdkr_modexp_batch.restype = ctypes.c_int
-    lib.fsdkr_miller_rabin.restype = ctypes.c_int
-    return lib
+_LIB = _loader.get_lib(
+    os.path.abspath(_SRC),
+    "_fsdkr_native",
+    ("fsdkr_modexp", "fsdkr_modexp_batch", "fsdkr_miller_rabin"),
+)
 
 
 def _get() -> Optional[ctypes.CDLL]:
-    global _lib, _tried
-    if not _tried:
-        with _lock:
-            if not _tried:
-                _lib = _build()
-                _tried = True
-    return _lib
+    return _LIB.get()
 
 
 def available() -> bool:
-    return _get() is not None
+    return _LIB.available()
 
 
 def _limbs_for(x: int) -> int:

@@ -1,0 +1,125 @@
+"""Shared build/cache/load machinery for the native C++ cores.
+
+Both ctypes bridges (the bignum core in __init__.py, the secp256k1 core
+in ec.py) compile their single source file on first use with g++, cache
+the .so next to this package tagged by source hash + machine arch (a
+stale or cross-arch artifact can never be picked up), prune artifacts
+from older revisions, and degrade to pure Python when anything fails.
+One implementation here so compile flags and race handling cannot
+drift between the cores.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import platform
+import subprocess
+import tempfile
+import threading
+from typing import Dict, Optional, Sequence
+
+
+class NativeLib:
+    """Lazy, thread-safe loader for one C++ source file.
+
+    src: absolute path to the .cpp; prefix: cached-.so name prefix
+    (also the prune pattern); symbols: exported function names, each
+    given restype c_int; env_var: optional kill switch (value in
+    {0, off, false, no} disables the build entirely).
+    """
+
+    def __init__(
+        self,
+        src: str,
+        prefix: str,
+        symbols: Sequence[str],
+        env_var: Optional[str] = None,
+    ):
+        self._src = src
+        self._prefix = prefix
+        self._symbols = list(symbols)
+        self._env_var = env_var
+        self._lib: Optional[ctypes.CDLL] = None
+        self._tried = False
+        self._lock = threading.Lock()
+
+    def _so_path(self) -> str:
+        with open(self._src, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        return os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            f"{self._prefix}_{tag}_{platform.machine()}.so",
+        )
+
+    def _build(self) -> Optional[ctypes.CDLL]:
+        if self._env_var and os.environ.get(self._env_var, "1") in (
+            "0", "off", "false", "no",
+        ):
+            return None
+        src = os.path.abspath(self._src)
+        if not os.path.exists(src):
+            return None
+        so = self._so_path()
+        if not os.path.exists(so):
+            fd, tmp = tempfile.mkstemp(
+                suffix=".so", prefix="_fsdkr_build_", dir=os.path.dirname(so)
+            )
+            os.close(fd)
+            cmd = [
+                "g++", "-O3", "-march=native", "-shared", "-fPIC",
+                "-o", tmp, src,
+            ]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so)
+            except (subprocess.SubprocessError, OSError):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return None
+            here = os.path.dirname(so)
+            for name in os.listdir(here):
+                if name.startswith(self._prefix) and name.endswith(".so"):
+                    path = os.path.join(here, name)
+                    if path != so:
+                        try:
+                            os.unlink(path)
+                        except OSError:
+                            pass
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        for sym in self._symbols:
+            getattr(lib, sym).restype = ctypes.c_int
+        return lib
+
+    def get(self) -> Optional[ctypes.CDLL]:
+        if not self._tried:
+            with self._lock:
+                if not self._tried:
+                    self._lib = self._build()
+                    self._tried = True
+        return self._lib
+
+    def available(self) -> bool:
+        return self.get() is not None
+
+
+_REGISTRY: Dict[str, NativeLib] = {}
+
+
+def get_lib(
+    src: str,
+    prefix: str,
+    symbols: Sequence[str],
+    env_var: Optional[str] = None,
+) -> NativeLib:
+    """Process-wide NativeLib per prefix (so repeated imports share one
+    build attempt)."""
+    if prefix not in _REGISTRY:
+        _REGISTRY[prefix] = NativeLib(src, prefix, symbols, env_var)
+    return _REGISTRY[prefix]

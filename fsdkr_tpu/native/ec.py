@@ -1,0 +1,142 @@
+"""ctypes bridge to the native secp256k1 host core (csrc/fsdkr_ec.cpp).
+
+The reference's EC layer is curv's pure-Rust secp256k1; the rebuild's
+Python Jacobian oracle (fsdkr_tpu/core/secp256k1.py) carries the
+semantics, and this module is the same math in C++ for the host-routed
+verification paths, where interpreter overhead dominates (a t=128
+Feldman check costs ~26 ms in Python, ~95% of it interpreter work).
+Check sites served: `/root/reference/src/refresh_message.rs:177-188`
+(Feldman), `/root/reference/src/zk_pdl_with_slack.rs:124-127` (PDL u1).
+
+Same build discipline as the bignum core: compiled on first use with
+g++, hash-tagged .so cached next to this file, every entry point
+degrades to pure Python when the toolchain is unavailable, and
+FSDKR_NATIVE_EC=0 disables the whole module. Inputs here are public
+broadcast values (commitments, proof points, indices), so no wipe
+discipline applies; arithmetic is variable-time, matching the Python
+oracle it replaces.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from . import _loader
+
+__all__ = [
+    "available",
+    "horner_batch",
+    "scalar_mul_batch",
+    "lincomb2_batch",
+]
+
+Affine = Optional[Tuple[int, int]]  # None = point at infinity
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc", "fsdkr_ec.cpp")
+
+_LIB = _loader.get_lib(
+    os.path.abspath(_SRC),
+    "_fsdkr_ec",
+    (
+        "fsdkr_ec_horner_batch",
+        "fsdkr_ec_scalar_mul_batch",
+        "fsdkr_ec_lincomb2_batch",
+    ),
+    env_var="FSDKR_NATIVE_EC",
+)
+
+
+def _get() -> Optional[ctypes.CDLL]:
+    return _LIB.get()
+
+
+def available() -> bool:
+    return _LIB.available()
+
+
+def _points_buf(points: Sequence[Affine]) -> ctypes.Array:
+    """(x, y) pairs as 8 LE u64 limbs each; None -> (0, 0) identity."""
+    buf = bytearray(len(points) * 64)
+    for i, pt in enumerate(points):
+        if pt is not None:
+            x, y = pt
+            buf[i * 64 : i * 64 + 32] = x.to_bytes(32, "little")
+            buf[i * 64 + 32 : i * 64 + 64] = y.to_bytes(32, "little")
+    return (ctypes.c_uint64 * (len(points) * 8)).from_buffer_copy(buf)
+
+
+def _scalars_buf(scalars: Sequence[int]) -> ctypes.Array:
+    buf = bytearray(len(scalars) * 32)
+    for i, s in enumerate(scalars):
+        buf[i * 32 : (i + 1) * 32] = s.to_bytes(32, "little")
+    return (ctypes.c_uint64 * (len(scalars) * 4)).from_buffer_copy(buf)
+
+
+def _read_points(out: ctypes.Array, n: int) -> List[Affine]:
+    mv = memoryview(bytearray(out))
+    res: List[Affine] = []
+    for i in range(n):
+        x = int.from_bytes(mv[i * 64 : i * 64 + 32], "little")
+        y = int.from_bytes(mv[i * 64 + 32 : i * 64 + 64], "little")
+        res.append(None if x == 0 and y == 0 else (x, y))
+    return res
+
+
+def horner_batch(
+    commitments: Sequence[Affine], indices: Sequence[int]
+) -> Optional[List[Affine]]:
+    """[sum_k A_k * u^k for u in indices] — the Feldman evaluation.
+    Returns None when the native core is unavailable (caller falls back
+    to the Python oracle)."""
+    lib = _get()
+    if lib is None or not commitments or not indices:
+        return None
+    if any(not (0 <= u < (1 << 32)) for u in indices):
+        return None
+    commits = _points_buf(commitments)
+    idx = (ctypes.c_uint32 * len(indices))(*indices)
+    out = (ctypes.c_uint64 * (len(indices) * 8))()
+    rc = lib.fsdkr_ec_horner_batch(
+        commits, len(commitments), idx, len(indices), out
+    )
+    if rc != 0:
+        return None
+    return _read_points(out, len(indices))
+
+
+def scalar_mul_batch(
+    points: Sequence[Affine], scalars: Sequence[int]
+) -> Optional[List[Affine]]:
+    """[s_i * P_i]; scalars must be reduced mod the group order."""
+    lib = _get()
+    if lib is None or not points:
+        return None
+    pts = _points_buf(points)
+    sc = _scalars_buf(scalars)
+    out = (ctypes.c_uint64 * (len(points) * 8))()
+    rc = lib.fsdkr_ec_scalar_mul_batch(pts, sc, len(points), out)
+    if rc != 0:
+        return None
+    return _read_points(out, len(points))
+
+
+def lincomb2_batch(
+    P: Sequence[Affine],
+    a: Sequence[int],
+    Q: Sequence[Affine],
+    b: Sequence[int],
+) -> Optional[List[Affine]]:
+    """[a_i*P_i + b_i*Q_i] — the PDL u1 shape. Scalars reduced mod q."""
+    lib = _get()
+    if lib is None or not P:
+        return None
+    rc_out = (ctypes.c_uint64 * (len(P) * 8))()
+    rc = lib.fsdkr_ec_lincomb2_batch(
+        _points_buf(P), _scalars_buf(a), _points_buf(Q), _scalars_buf(b),
+        len(P), rc_out,
+    )
+    if rc != 0:
+        return None
+    return _read_points(rc_out, len(P))

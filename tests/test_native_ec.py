@@ -1,0 +1,97 @@
+"""Differential tests: native secp256k1 core (csrc/fsdkr_ec.cpp via
+fsdkr_tpu.native.ec) against the pure-Python Jacobian oracle
+(fsdkr_tpu.core.secp256k1). The oracle stays native-free by design —
+these tests are the bridge's correctness anchor."""
+
+import secrets
+
+import pytest
+
+from fsdkr_tpu.core import secp256k1 as E
+from fsdkr_tpu.core import vss
+from fsdkr_tpu.native import ec as native_ec
+
+pytestmark = pytest.mark.skipif(
+    not native_ec.available(), reason="native EC core unavailable"
+)
+
+Q = E.CURVE_ORDER
+G = E.GENERATOR
+
+
+def rand_point():
+    return G * E.Scalar.from_int(secrets.randbelow(Q - 1) + 1)
+
+
+def as_xy(p):
+    return None if p.infinity else (p.x, p.y)
+
+
+class TestScalarMul:
+    def test_differential_including_edges(self):
+        pts, scs, want = [], [], []
+        for s in [0, 1, 2, Q - 1, Q // 2, secrets.randbelow(Q)]:
+            P = rand_point()
+            pts.append(as_xy(P))
+            scs.append(s)
+            want.append(as_xy(P * E.Scalar.from_int(s)))
+        pts.append(None)  # identity input
+        scs.append(12345)
+        want.append(None)
+        assert native_ec.scalar_mul_batch(pts, scs) == want
+
+
+class TestHorner:
+    def test_matches_python_horner(self):
+        commits = [rand_point() for _ in range(9)]
+        idxs = [1, 2, 7, 255, 65535]
+        want = []
+        for u in idxs:
+            acc = E.Point.identity()
+            for a_k in reversed(commits):
+                acc = acc * u + a_k
+            want.append(as_xy(acc))
+        got = native_ec.horner_batch([as_xy(c) for c in commits], idxs)
+        assert got == want
+
+    def test_index_overflow_returns_none(self):
+        commits = [as_xy(rand_point())]
+        assert native_ec.horner_batch(commits, [1 << 32]) is None
+
+
+class TestLincomb2:
+    def test_matches_python(self):
+        P, Qp = rand_point(), rand_point()
+        a = [0, 1, secrets.randbelow(Q), Q - 1]
+        b = [secrets.randbelow(Q), 0, secrets.randbelow(Q), 1]
+        want = [
+            as_xy(P * E.Scalar.from_int(ai) + Qp * E.Scalar.from_int(bi))
+            for ai, bi in zip(a, b)
+        ]
+        got = native_ec.lincomb2_batch(
+            [as_xy(P)] * 4, a, [as_xy(Qp)] * 4, b
+        )
+        assert got == want
+
+
+class TestFeldmanRouting:
+    def test_host_backend_matches_oracle_and_rejects_tamper(self):
+        """HostBatchVerifier.validate_feldman (native-routed) must agree
+        with vss.validate_share_public (pure Python) on valid shares and
+        on a tampered one."""
+        from fsdkr_tpu.backend.batch_verifier import HostBatchVerifier
+
+        t, n = 3, 8
+        secret = E.Scalar.from_int(secrets.randbelow(Q - 1) + 1)
+        scheme, shares = vss.share(t, n, secret)
+        pub = [G * s for s in shares]
+        items = [(scheme, pub[i], i + 1) for i in range(n)]
+        # tamper one public share
+        items.append((scheme, pub[0] + G, 2))
+        got = HostBatchVerifier().validate_feldman(items)
+        want = [
+            scheme.validate_share_public(point, idx)
+            for scheme, point, idx in items
+        ]
+        assert got == want
+        assert got[:n] == [True] * n and got[n] is False
