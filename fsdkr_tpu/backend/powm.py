@@ -194,9 +194,13 @@ def tpu_powm_shared(bases, exps_per_group, moduli) -> List[List[int]]:
 
     if not bases:
         return []
-    if not _device_powm():  # CPU fallback: native core, one batch/group
+    if not _device_powm():  # CPU fallback: native fixed-base comb —
+        # one squaring ladder per (base, modulus), amortized over the
+        # group's rows (same structure the device comb kernel exploits)
+        from .. import native
+
         return [
-            host_powm([b] * len(es), es, [m] * len(es)) if es else []
+            native.modexp_shared(b, es, m) if es else []
             for b, es, m in zip(bases, exps_per_group, moduli)
         ]
     w_cnt = max(

@@ -23,6 +23,7 @@ __all__ = [
     "available",
     "modexp",
     "modexp_batch",
+    "modexp_shared",
     "is_probable_prime",
 ]
 
@@ -33,7 +34,8 @@ _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc", "fsdkr_native
 _LIB = _loader.get_lib(
     os.path.abspath(_SRC),
     "_fsdkr_native",
-    ("fsdkr_modexp", "fsdkr_modexp_batch", "fsdkr_miller_rabin"),
+    ("fsdkr_modexp", "fsdkr_modexp_batch", "fsdkr_modexp_shared",
+     "fsdkr_miller_rabin"),
 )
 
 
@@ -137,6 +139,36 @@ def modexp_batch(
         _wipe_buf(base_buf, exp_buf, mod_buf, out)
         return [pow(b, e, m) for b, e, m in zip(bases, exps, mods)]
     res = _from_buf(out, rows, L)
+    _wipe_buf(base_buf, exp_buf, mod_buf, out)
+    return res
+
+
+def modexp_shared(
+    base: int, exps: Sequence[int], mod: int
+) -> List[int]:
+    """base^exps[i] mod mod via the fixed-base comb — the shared-base
+    column shape of the verify loop (one squaring ladder amortized over
+    the whole group). Falls back to CPython pow when native is
+    unavailable or the modulus is even/oversized."""
+    if not exps:
+        return []
+    lib = _get()
+    L = _limbs_for(mod)
+    if lib is None or L > _MAX_LIMBS or mod % 2 == 0 or mod <= 1:
+        return [pow(base, e, mod) for e in exps]
+    EL = max(1, max(_limbs_for(e) for e in exps))
+    if EL > 2 * _MAX_LIMBS:  # comb table would be attacker-sized
+        return [pow(base, e, mod) for e in exps]
+    m_rows = len(exps)
+    out = (ctypes.c_uint64 * (m_rows * L))()
+    base_buf = _to_buf([base % mod], L)
+    exp_buf = _to_buf(list(exps), EL)
+    mod_buf = _to_buf([mod], L)
+    rc = lib.fsdkr_modexp_shared(base_buf, exp_buf, mod_buf, out, m_rows, L, EL)
+    if rc != 0:
+        _wipe_buf(base_buf, exp_buf, mod_buf, out)
+        return [pow(base, e, mod) for e in exps]
+    res = _from_buf(out, m_rows, L)
     _wipe_buf(base_buf, exp_buf, mod_buf, out)
     return res
 

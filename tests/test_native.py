@@ -70,3 +70,26 @@ class TestMillerRabin:
 
         p = primes.gen_prime(256)
         assert native.is_probable_prime(p, 30) is True
+
+
+class TestModexpShared:
+    def test_differential_vs_pow(self):
+        """Fixed-base comb vs CPython pow: random, zero, one, full-width
+        exponents over one shared (base, modulus)."""
+        from fsdkr_tpu import native
+
+        mod = (1 << 1023) * 2 + 12345 * 2 + 1  # odd 1024-bit
+        base = 0xDEADBEEF << 512
+        exps = [0, 1, 2, 15, 16, (1 << 512) - 3, (1 << 1024) - 1]
+        import secrets as _s
+
+        exps += [_s.randbits(1024) for _ in range(9)]
+        got = native.modexp_shared(base, exps, mod)
+        assert got == [pow(base, e, mod) for e in exps]
+
+    def test_even_modulus_falls_back(self):
+        from fsdkr_tpu import native
+
+        assert native.modexp_shared(7, [5, 0], 100) == [
+            pow(7, 5, 100), 1,
+        ]
