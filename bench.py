@@ -106,10 +106,9 @@ def init_jax_with_retry(attempts=4, delay=15.0):
             # Degrade to an honest CPU-platform measurement instead of a
             # zero datapoint (rounds 3 and 4 both recorded 0 proofs/s
             # through multi-hour tunnel outages). The emitted metric is
-            # tagged with the platform and a fallback note, and the
-            # workload shrinks to fallback-sized parameters unless the
-            # caller pinned them (BENCH_CPU_FALLBACK=0 restores the old
-            # fail-hard behavior).
+            # tagged with the platform and a fallback note
+            # (BENCH_CPU_FALLBACK=0 restores the old fail-hard
+            # behavior).
             if os.environ.get("BENCH_CPU_FALLBACK", "1") != "1":
                 raise RuntimeError(
                     f"TPU backend unreachable after {attempts} probes"
@@ -122,10 +121,11 @@ def init_jax_with_retry(attempts=4, delay=15.0):
                 "on the XLA:CPU fallback platform (structural datapoint, "
                 "not a chip number)"
             )
-            os.environ.setdefault("BENCH_N", "8")
-            os.environ.setdefault("BENCH_T", "4")
-            os.environ.setdefault("BENCH_BITS", "768")
-            os.environ.setdefault("BENCH_M", "32")
+            # The fallback runs the NOMINAL shape (main()'s n=16, full
+            # 2048-bit defaults): with the native host engines that is
+            # ~6 min on this box, and the recorded metric stays directly
+            # comparable to the on-chip rounds (same "n=16,t=8,2048-bit"
+            # label, honest platform tag).
 
     import jax
 
@@ -162,14 +162,38 @@ def init_jax_with_retry(attempts=4, delay=15.0):
     )
 
 
+def _host_cpu_tag() -> str:
+    """Fingerprint of this host's CPU feature set. The persistent cache
+    survives across VM instances of this environment whose CPUs differ
+    slightly; XLA:CPU AOT entries compiled under one feature set can
+    SIGILL (silently killing the bench, no JSON line) when loaded under
+    another — the loader itself warns "could lead to execution errors
+    such as SIGILL". Scoping the cache per feature set makes stale
+    entries unloadable instead of fatal."""
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    return hashlib.sha256(feats.encode()).hexdigest()[:10]
+    except OSError:
+        pass
+    import platform as _platform
+
+    return _platform.machine()
+
+
 def _jax_cache_dir() -> str:
     """Repo-relative persistent compilation cache (overridable via
-    FSDKR_JAX_CACHE), derived from this file's location instead of a
-    hardcoded absolute path."""
-    return os.environ.get(
+    FSDKR_JAX_CACHE), derived from this file's location and scoped per
+    host-CPU feature set (see _host_cpu_tag)."""
+    base = os.environ.get(
         "FSDKR_JAX_CACHE",
         os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
     )
+    return os.path.join(base, _host_cpu_tag())
 
 
 def _jax_cache_entries() -> int:
@@ -362,7 +386,7 @@ def bench_join(n, t, bits, m_sec, joins):
 def main():
     jax, _ = init_jax_with_retry()
 
-    # read the workload AFTER init: a tunnel-down fallback shrinks the
+    # read the workload AFTER init: a tunnel-down fallback annotates the
     # parameters via environment defaults set inside the retry helper
     n = int(os.environ.get("BENCH_N", "16"))
     t = int(os.environ.get("BENCH_T", "8"))
