@@ -304,6 +304,9 @@ def bench_sessions(sessions_count, n, t, bits, m_sec):
             "sessions": sessions_count,
             "device_ec": tpu_cfg.device_ec,
             "device_powm": tpu_cfg.device_powm,
+            "pallas": os.environ.get("FSDKR_PALLAS", "auto"),
+            **({"degraded": os.environ["BENCH_DEGRADED"]}
+               if os.environ.get("BENCH_DEGRADED") else {}),
             "mesh": mesh_shape,
             **roofline_fields(t_warm),
         }
@@ -378,6 +381,9 @@ def bench_join(n, t, bits, m_sec, joins):
             "replace_s": round(t_replace, 2),
             "device_ec": tpu_cfg.device_ec,
             "device_powm": tpu_cfg.device_powm,
+            "pallas": os.environ.get("FSDKR_PALLAS", "auto"),
+            **({"degraded": os.environ["BENCH_DEGRADED"]}
+               if os.environ.get("BENCH_DEGRADED") else {}),
             **roofline_fields(t_warm),
         }
     )
@@ -557,9 +563,15 @@ def main():
         # otherwise both baselines are CPython and this flags it
         "host_native_available": native.available(),
         # which routes the hot paths took (auto-routed by platform,
-        # forceable via FSDKR_DEVICE_EC / FSDKR_DEVICE_POWM)
+        # forceable via FSDKR_DEVICE_EC / FSDKR_DEVICE_POWM), and which
+        # modexp pipeline (a preflight-degraded battery sets
+        # BENCH_DEGRADED so XLA-chain numbers can never read as the
+        # nominal Pallas configuration)
         "device_ec": tpu_cfg.device_ec,
         "device_powm": tpu_cfg.device_powm,
+        "pallas": os.environ.get("FSDKR_PALLAS", "auto"),
+        **({"degraded": os.environ["BENCH_DEGRADED"]}
+           if os.environ.get("BENCH_DEGRADED") else {}),
         "collect_warm_s": round(t_tpu, 2),
         "collect_cold_s": round(t_tpu_cold, 2),
         "compile_overhead_s": round(t_tpu_cold - t_tpu, 2),

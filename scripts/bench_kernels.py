@@ -228,8 +228,11 @@ def main():
         ]
         batch_sweep = [128, 256, 512, 1024, 2048, 4096, 8192, 16384]
 
+    # FSDKR_NO_PALLAS=1: the battery preflight found the Pallas kernels
+    # unlowerable for TPU — measuring them would die at compile on chip
+    no_pallas = os.environ.get("FSDKR_NO_PALLAS") == "1"
     kinds = ["cios", "rns"]
-    if jax.default_backend() == "tpu":
+    if jax.default_backend() == "tpu" and not no_pallas:
         kinds.append("rns-pallas")
 
     log("== generic kernels ==")
@@ -252,7 +255,7 @@ def main():
 
     log("== comb kernels ==")
     comb_kinds = ["comb-cios", "comb-rns"]
-    if jax.default_backend() == "tpu":
+    if jax.default_backend() == "tpu" and not no_pallas:
         comb_kinds.append("comb-rns-pallas")
     for bits, e, g, m in comb_points:
         for kind in comb_kinds:

@@ -31,11 +31,18 @@ def load(path):
 def main():
     root = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else "bench_results")
     configs, kernels, traces, ec_ab = [], [], {}, []
+    mfu, other_kernel_recs = [], 0
     for path in sorted(root.glob("m_*.json")):
         name = path.stem[2:]
         for rec in load(path):
-            if "kernel" in rec:
-                kernels.append(rec)
+            if "kernel" in rec and "seconds" in rec:
+                kernels.append(rec)  # bench_kernels.py sweep rows
+            elif "kernel" in rec and "mfu_wall" in rec:
+                mfu.append(rec)  # profile_mfu.py rows
+            elif "kernel" in rec:
+                # preflight lowering records ({kernel, ok, mosaic}) and
+                # mfu error rows carry no timings: count, don't tabulate
+                other_kernel_recs += 1
             elif "shape" in rec:  # scripts/bench_ec.py A/B records
                 ec_ab.append(rec)
             elif "metric" in rec:
@@ -90,6 +97,20 @@ def main():
             print(
                 f"| {r['kernel']} | {r['bits']} | {r['exp_bits']} | {r['rows']} "
                 f"| {r.get('groups', '—')} | {r['seconds']} | {r['modexp_per_s']} |"
+            )
+        print()
+
+    if mfu:
+        print("### profiler-measured MFU (scripts/profile_mfu.py)\n")
+        print("| kernel | bits | rows | wall s | device s | modexp/s "
+              "| MFU(wall) | MFU(device) | occupancy |")
+        print("|---|---|---|---|---|---|---|---|---|")
+        for r in mfu:
+            print(
+                f"| {r['kernel']} | {r['bits']} | {r['rows']} "
+                f"| {r['wall_s']} | {r.get('device_s', '—')} "
+                f"| {r['modexp_per_s']} | {r['mfu_wall']} "
+                f"| {r.get('mfu_device', '—')} | {r.get('occupancy', '—')} |"
             )
         print()
 

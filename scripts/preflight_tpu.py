@@ -30,7 +30,11 @@ def log(msg):
 def run_tiny_refresh(pallas_mode: str, mesh_shape=None):
     """One n=4 refresh at TEST_CONFIG size; returns captured calls."""
     os.environ["FSDKR_PALLAS"] = pallas_mode
-    os.environ["FSDKR_DEVICE_EC"] = "1"  # the TPU-platform routing
+    # force the TPU-platform routing: auto would send EC and modexp to
+    # the host engines on this CPU host and the capture would never
+    # reach the device kernels the preflight exists to lower
+    os.environ["FSDKR_DEVICE_EC"] = "1"
+    os.environ["FSDKR_DEVICE_POWM"] = "1"
     # force the batched-device columns even at tiny row counts so the
     # RNS/comb kernels are reached the way a full-size collect reaches them
     os.environ.setdefault("FSDKR_RNS_MIN_ROWS", "1")

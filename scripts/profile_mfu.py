@@ -180,6 +180,8 @@ def main():
             ("rns", 4096, 2048, 512),
             ("cios", 2048, 2048, 512),
         ]
+    if os.environ.get("FSDKR_NO_PALLAS") == "1":  # see bench_kernels.py
+        points = [p for p in points if "pallas" not in p[0]]
     for kind, bits, eb, rows in points:
         try:
             profile_point(kind, bits, eb, rows)
