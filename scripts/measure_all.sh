@@ -57,21 +57,24 @@ run() {  # name, timeout, [VAR=V ...] cmd args...   (no '--': env treats
 # hardware-only compile failures motivated this. On failure, degrade
 # the battery to the XLA chain (FSDKR_PALLAS=0) instead of letting the
 # first bench step die at compile.
-degrade() {  # preflight said the Pallas kernels cannot lower for TPU
-  echo "preflight FAILED: degrading to the XLA chain (FSDKR_PALLAS=0)"
+degrade() {  # $1: provenance label recorded by bench.py per step
+  echo "degrading to the XLA chain ($1)"
   export FSDKR_PALLAS=0      # bench steps use the XLA chain
   export FSDKR_NO_PALLAS=1   # sweep/mfu skip their *-pallas points
-  export BENCH_DEGRADED=xla-chain  # bench.py records the mode per step
+  export BENCH_DEGRADED="$1" # so degraded numbers can never read as
+                             # the nominal Pallas configuration
 }
 if [ -e "$R/m_preflight.failed" ]; then
-  degrade  # decided on a previous launch; don't re-pay 20 min chipless
+  degrade xla-chain  # decided on a previous launch; don't re-pay 20 min
+elif [ -e "$R/onchip_degraded" ]; then
+  degrade xla-chain-onchip  # a previous launch hit a Mosaic backend error
 elif [ ! -e "$R/m_preflight.ok" ]; then
   echo "=== preflight ($(date +%H:%M:%S)) ==="
   if timeout 1200 python scripts/preflight_tpu.py > "$R/preflight.json" 2> "$R/preflight.log"; then
     touch "$R/m_preflight.ok"
   else
     touch "$R/m_preflight.failed"
-    degrade
+    degrade xla-chain
   fi
   tail -2 "$R/preflight.log"
 fi
@@ -79,6 +82,23 @@ fi
 # judge-facing collect() configs first (known-good kernel families at
 # n=16 as of round 2; RNS engages at >=512-row columns)
 run n16 2400 FSDKR_TRACE=1 python bench.py
+# AOT lowering cannot see Mosaic *backend* failures (VMEM budgeting,
+# register allocation): if the first on-chip step died with a
+# compile-class error — and the battery is not already degraded — keep
+# the evidence, degrade persistently, and retry once instead of burning
+# every later step's timeout on the same failure. Transient tunnel
+# deaths (timeouts, connection losses) do NOT match the pattern and
+# retry un-degraded on the next battery relaunch.
+if [ -z "$BENCH_DEGRADED" ] && [ ! -e "$R/m_n16.ok" ] && grep -qE \
+    "NotImplementedError|[Mm]osaic|RESOURCE_EXHAUSTED|VMEM|out of memory" \
+    "$R/m_n16.log" 2>/dev/null; then
+  echo "n16 died with a compile-class error: degrading persistently"
+  cp "$R/m_n16.log" "$R/n16_pallas_fail.log"  # keep the compile error
+  [ -e "$R/m_n16.json.failed" ] && cp "$R/m_n16.json.failed" "$R/n16_pallas_fail.json"
+  touch "$R/onchip_degraded"
+  degrade xla-chain-onchip
+  run n16 2400 FSDKR_TRACE=1 python bench.py
+fi
 run n64 3600 BENCH_N=64 BENCH_T=32 FSDKR_TRACE=1 python bench.py
 run join32 2400 BENCH_N=32 BENCH_T=15 BENCH_JOIN=2 python bench.py
 run sessions16 4800 BENCH_SESSIONS=16 BENCH_N=16 BENCH_T=8 python bench.py
