@@ -105,6 +105,77 @@ static void mont_mul(u64 *out, const u64 *a, const u64 *b, const u64 *n,
     std::memcpy(out, t, sizeof(u64) * L);
 }
 
+// ---------------------------------------------------------------------------
+// Dedicated Montgomery squaring: out = a * a * R^{-1} mod n. SOS layout —
+// the symmetric half of the schoolbook product is computed once and
+// doubled (L(L+1)/2 limb products instead of L^2), then a separate
+// Montgomery reduction pass (L^2 products) finishes. Measured 0.66x the
+// general mont_mul at 64 limbs, 0.69x at 32, 0.76x at 24 on this class
+// of host — and every modexp ladder is ~4 squarings per multiply, so the
+// squaring chain is where modexp wall-clock actually lives.
+
+static void mont_sqr(u64 *out, const u64 *a, const u64 *n, u64 n0inv, int L) {
+  u64 t[2 * MAXL + 1];
+  std::memset(t, 0, sizeof(u64) * (2 * L + 1));
+  // cross products a_i * a_j (i < j), each summed once. t[i+L] is
+  // provably still zero when row i deposits its final carry there (rows
+  // i' < i only reach position i'+L < i+L), so no carry-out can wrap.
+  for (int i = 0; i < L; i++) {
+    u128 carry = 0;
+    const u64 ai = a[i];
+    for (int j = i + 1; j < L; j++) {
+      u128 cur = (u128)ai * a[j] + t[i + j] + carry;
+      t[i + j] = (u64)cur;
+      carry = cur >> 64;
+    }
+    t[i + L] += (u64)carry;
+  }
+  // double the cross half, then add the diagonal a_i^2 terms
+  {
+    u64 c = 0;
+    for (int i = 0; i < 2 * L; i++) {
+      u64 hi = t[i] >> 63;
+      t[i] = (t[i] << 1) | c;
+      c = hi;
+    }
+    t[2 * L] = c;
+  }
+  {
+    u128 carry = 0;
+    for (int i = 0; i < L; i++) {
+      u128 cur = (u128)a[i] * a[i] + t[2 * i] + carry;
+      t[2 * i] = (u64)cur;
+      carry = cur >> 64;
+      cur = (u128)t[2 * i + 1] + carry;
+      t[2 * i + 1] = (u64)cur;
+      carry = cur >> 64;
+    }
+    t[2 * L] += (u64)carry;
+  }
+  // Montgomery reduction of the 2L-word square
+  for (int i = 0; i < L; i++) {
+    const u64 m = t[i] * n0inv;
+    u128 carry = 0;
+    for (int j = 0; j < L; j++) {
+      u128 cur = (u128)m * n[j] + t[i + j] + carry;
+      t[i + j] = (u64)cur;
+      carry = cur >> 64;
+    }
+    for (int j = i + L; carry && j <= 2 * L; j++) {
+      u128 cur = (u128)t[j] + carry;
+      t[j] = (u64)cur;
+      carry = cur >> 64;
+    }
+  }
+  // result in t[L..2L]; t[2L] in {0,1} and the value is < 2n. The stack
+  // temp is left to be overwritten by the next call, matching mont_mul:
+  // the wipe discipline lives in the calling frames' persistent buffers.
+  if (t[2 * L] != 0 || cmp_limbs(t + L, n, L) >= 0)
+    sub_limbs(out, t + L, n, L);
+  else
+    std::memcpy(out, t + L, sizeof(u64) * L);
+}
+
 // R mod n and R^2 mod n by doubling (L <= MAXL)
 static void mont_constants(const u64 *n, int L, u64 *r_mod, u64 *r2_mod) {
   // r_mod = R mod n: start from 2^(64L - 1) mod n (top bit), double once
@@ -140,11 +211,17 @@ static void mont_constants(const u64 *n, int L, u64 *r_mod, u64 *r2_mod) {
 
 // ---------------------------------------------------------------------------
 // modexp: out = base^exp mod n. n odd, L limbs; exp EL limbs.
-// 4-bit fixed window, MSB-first.
+// Fixed wbits-wide window (4..8, caller-chosen by exponent width: wider
+// windows trade table-build multiplies for fewer per-window lookups, so
+// w=6 wins for full-width exponents and w=4 for short ones), MSB-first.
 
-int fsdkr_modexp(const u64 *base, const u64 *exp, const u64 *n, u64 *out,
-                 int L, int EL) {
-  if (L <= 0 || L > MAXL || EL <= 0 || !(n[0] & 1))
+int fsdkr_modexp_w(const u64 *base, const u64 *exp, const u64 *n, u64 *out,
+                   int L, int EL, int wbits) {
+  // wbits capped at 6: the 2^wbits-entry stack table is 32 KB there, and
+  // the build-vs-lookup tradeoff already tips back past w=6 for every
+  // protocol exponent width
+  if (L <= 0 || L > MAXL || EL <= 0 || wbits < 1 || wbits > 6 ||
+      !(n[0] & 1))
     return -1;
 
   const u64 n0inv = mont_n0inv(n[0]);
@@ -161,12 +238,18 @@ int fsdkr_modexp(const u64 *base, const u64 *exp, const u64 *n, u64 *out,
   u64 base_m[MAXL];
   mont_mul(base_m, b, r2, n, n0inv, L);
 
-  // window table: t[w] = base^w in Montgomery form
-  u64 table[16][MAXL];
+  // window table: t[d] = base^d in Montgomery form (even entries are
+  // squares of earlier entries — cheaper than a multiply)
+  const int D = 1 << wbits;
+  u64 table[64][MAXL];
   std::memcpy(table[0], one_m, sizeof(u64) * L);
   std::memcpy(table[1], base_m, sizeof(u64) * L);
-  for (int w = 2; w < 16; w++)
-    mont_mul(table[w], table[w - 1], base_m, n, n0inv, L);
+  for (int d = 2; d < D; d++) {
+    if (d % 2 == 0)
+      mont_sqr(table[d], table[d / 2], n, n0inv, L);
+    else
+      mont_mul(table[d], table[d - 1], base_m, n, n0inv, L);
+  }
 
   // top set window
   int top_bit = -1;
@@ -186,7 +269,7 @@ int fsdkr_modexp(const u64 *base, const u64 *exp, const u64 *n, u64 *out,
     mont_mul(out, out, onev, n, n0inv, L); // leave Montgomery domain -> 1
     secure_wipe(b, L);
     secure_wipe(base_m, L);
-    secure_wipe(&table[0][0], 16 * MAXL);
+    secure_wipe(&table[0][0], D * MAXL);
     // one_m/r2 reconstruct the modulus (secret on the Paillier-decrypt
     // path where n = p^2): gcd(R - one_m, R^2 - r2) recovers it
     secure_wipe(one_m, L);
@@ -194,14 +277,17 @@ int fsdkr_modexp(const u64 *base, const u64 *exp, const u64 *n, u64 *out,
     return 0;
   }
 
-  int nwin = top_bit / 4; // highest window index
+  int nwin = top_bit / wbits; // highest window index
+  const u64 mask = (u64)D - 1;
   std::memcpy(acc, one_m, sizeof(u64) * L);
   for (int w = nwin; w >= 0; w--) {
-    for (int s = 0; s < 4; s++)
-      mont_mul(acc, acc, acc, n, n0inv, L);
-    // 4-bit windows never straddle a 64-bit limb (bit0 is a multiple of 4)
-    int bit0 = w * 4;
-    u64 d = (exp[bit0 / 64] >> (bit0 % 64)) & 0xF;
+    for (int s = 0; s < wbits; s++)
+      mont_sqr(acc, acc, n, n0inv, L);
+    int bit0 = w * wbits; // windows may straddle a 64-bit limb
+    u64 d = exp[bit0 / 64] >> (bit0 % 64);
+    if (bit0 % 64 + wbits > 64 && bit0 / 64 + 1 < EL)
+      d |= exp[bit0 / 64 + 1] << (64 - bit0 % 64);
+    d &= mask;
     mont_mul(acc, acc, table[d], n, n0inv, L);
   }
 
@@ -211,11 +297,17 @@ int fsdkr_modexp(const u64 *base, const u64 *exp, const u64 *n, u64 *out,
   mont_mul(out, acc, onev, n, n0inv, L);
   secure_wipe(b, L);
   secure_wipe(base_m, L);
-  secure_wipe(&table[0][0], 16 * MAXL);
+  secure_wipe(&table[0][0], D * MAXL);
   secure_wipe(acc, L);
   secure_wipe(one_m, L); // see exp==0 branch: these reconstruct n
   secure_wipe(r2, L);
   return 0;
+}
+
+// ABI-stable 4-bit-window entry point
+int fsdkr_modexp(const u64 *base, const u64 *exp, const u64 *n, u64 *out,
+                 int L, int EL) {
+  return fsdkr_modexp_w(base, exp, n, out, L, EL, 4);
 }
 
 // ---------------------------------------------------------------------------
@@ -270,7 +362,7 @@ int fsdkr_miller_rabin(const u64 *n, int L, const u64 *witnesses, int rounds) {
           }
     std::memcpy(x, one_m, sizeof(u64) * L);
     for (int bit = top_bit; bit >= 0; bit--) {
-      mont_mul(x, x, x, n, n0inv, L);
+      mont_sqr(x, x, n, n0inv, L);
       if ((d[bit / 64] >> (bit % 64)) & 1)
         mont_mul(x, x, a_m, n, n0inv, L);
     }
@@ -279,7 +371,7 @@ int fsdkr_miller_rabin(const u64 *n, int L, const u64 *witnesses, int rounds) {
       continue;
     bool witness = true;
     for (int i = 0; i < r - 1; i++) {
-      mont_mul(x, x, x, n, n0inv, L);
+      mont_sqr(x, x, n, n0inv, L);
       if (cmp_limbs(x, n1_m, L) == 0) {
         witness = false;
         break;
@@ -313,34 +405,44 @@ int fsdkr_miller_rabin(const u64 *n, int L, const u64 *witnesses, int rounds) {
 
 // Batched modexp over a column of rows (independent moduli): the host
 // backend's powm shape. Returns 0 on success, -1 on any bad row input.
-int fsdkr_modexp_batch(const u64 *bases, const u64 *exps, const u64 *mods,
-                       u64 *outs, int rows, int L, int EL) {
+int fsdkr_modexp_batch_w(const u64 *bases, const u64 *exps, const u64 *mods,
+                         u64 *outs, int rows, int L, int EL, int wbits) {
   for (int i = 0; i < rows; i++) {
-    int rc = fsdkr_modexp(bases + (size_t)i * L, exps + (size_t)i * EL,
-                          mods + (size_t)i * L, outs + (size_t)i * L, L, EL);
+    int rc = fsdkr_modexp_w(bases + (size_t)i * L, exps + (size_t)i * EL,
+                            mods + (size_t)i * L, outs + (size_t)i * L, L,
+                            EL, wbits);
     if (rc != 0)
       return rc;
   }
   return 0;
 }
 
+int fsdkr_modexp_batch(const u64 *bases, const u64 *exps, const u64 *mods,
+                       u64 *outs, int rows, int L, int EL) {
+  return fsdkr_modexp_batch_w(bases, exps, mods, outs, rows, L, EL, 4);
+}
+
 // Fixed-base comb: out[m] = base^exps[m] mod n for M exponents sharing
 // one (base, modulus) — the dominant column shape of the O(n^2) verify
 // loop (every receiver checks the same sender's h1/h2/T bases;
-// reference loop: src/refresh_message.rs:330-365). Per 4-bit window
-// position w the 16-entry table holds (base^(16^w))^d, so each row
-// costs only ~EL*16 multiplies and the squaring ladder is paid once in
-// the precompute (1 squaring + 14 muls per window), amortized over M.
-// ~4.5x over the generic kernel at full-width exponents and M >> 1.
-int fsdkr_modexp_shared(const u64 *base, const u64 *exps, const u64 *n,
-                        u64 *outs, int M, int L, int EL) {
+// reference loop: src/refresh_message.rs:330-365). Per wbits-wide window
+// position w the 2^wbits-entry table holds (base^((2^wbits)^w))^d, so
+// each row costs only ~ebits/wbits multiplies and the squaring ladder is
+// paid once in the precompute, amortized over M. The window width is a
+// caller choice: wider windows cut the per-row multiplies ~linearly but
+// grow the per-group table build by 2^wbits, so the bridge picks wbits
+// by rows-per-group (w=6 beats w=4 by ~22% at the ring-Pedersen M=256
+// shape; w=4 stays optimal for the n-row pair groups).
+int fsdkr_modexp_shared_w(const u64 *base, const u64 *exps, const u64 *n,
+                          u64 *outs, int M, int L, int EL, int wbits) {
   // EL is capped: verify-side exponents are adversary-supplied proof
-  // integers, and the comb table is EL*2048*L bytes — an unbounded EL
-  // would let one malicious proof force a huge (or throwing) allocation
-  // where the generic kernel merely computes slowly. 2*MAXL limbs =
-  // 8192 bits covers every protocol exponent incl. range slack.
+  // integers, and the comb table is (64 EL / wbits)*2^wbits*L words — an
+  // unbounded EL would let one malicious proof force a huge (or
+  // throwing) allocation where the generic kernel merely computes
+  // slowly. 2*MAXL limbs = 8192 bits covers every protocol exponent
+  // incl. range slack.
   if (L <= 0 || L > MAXL || EL <= 0 || EL > 2 * MAXL || M <= 0 ||
-      !(n[0] & 1))
+      wbits < 1 || wbits > 8 || !(n[0] & 1))
     return -1;
 
   const u64 n0inv = mont_n0inv(n[0]);
@@ -352,37 +454,47 @@ int fsdkr_modexp_shared(const u64 *base, const u64 *exps, const u64 *n,
   while (cmp_limbs(b, n, L) >= 0)
     sub_limbs(b, b, n, L);
 
-  const int W = EL * 16;  // 4-bit windows across the exponent limbs
-  u64 *table = new (std::nothrow) u64[(size_t)W * 16 * L];
+  const int D = 1 << wbits;             // table entries per window
+  const int W = (EL * 64 + wbits - 1) / wbits;  // windows over the limbs
+  u64 *table = new (std::nothrow) u64[(size_t)W * D * L];
   if (!table)
     return -1;
-  auto T = [&](int w, int d) { return table + ((size_t)w * 16 + d) * L; };
+  auto T = [&](int w, int d) { return table + ((size_t)w * D + d) * L; };
 
-  u64 pw[MAXL];  // base^(16^w) in Montgomery form
+  u64 pw[MAXL];  // base^((2^wbits)^w) in Montgomery form
   mont_mul(pw, b, r2, n, n0inv, L);
   for (int w = 0; w < W; w++) {
     std::memcpy(T(w, 0), one_m, sizeof(u64) * L);
     std::memcpy(T(w, 1), pw, sizeof(u64) * L);
-    for (int d = 2; d < 16; d++)
-      mont_mul(T(w, d), T(w, d - 1), pw, n, n0inv, L);
-    if (w + 1 < W)  // pw <- pw^16 = (pw^8)^2
-      mont_mul(pw, T(w, 8), T(w, 8), n, n0inv, L);
+    for (int d = 2; d < D; d++) {
+      if (d % 2 == 0)
+        mont_sqr(T(w, d), T(w, d / 2), n, n0inv, L);
+      else
+        mont_mul(T(w, d), T(w, d - 1), pw, n, n0inv, L);
+    }
+    if (w + 1 < W)  // pw <- pw^(2^wbits) = (pw^(2^(wbits-1)))^2
+      mont_sqr(pw, T(w, D / 2), n, n0inv, L);
   }
 
   u64 onev[MAXL];
   std::memset(onev, 0, sizeof(u64) * L);
   onev[0] = 1;
   u64 acc[MAXL];
+  const u64 mask = (u64)D - 1;
   for (int m = 0; m < M; m++) {
     const u64 *e = exps + (size_t)m * EL;
     std::memcpy(acc, one_m, sizeof(u64) * L);
     // one multiply per window unconditionally (d == 0 hits the one_m
     // entry): prover-side exponents are secret key shares and nonces,
-    // and a zero-nibble skip would make wall time a function of their
+    // and a zero-digit skip would make wall time a function of their
     // contents — the generic kernel is uniform per window for the same
     // reason
     for (int w = 0; w < W; w++) {
-      u64 d = (e[w / 16] >> ((w % 16) * 4)) & 0xF;
+      int bit0 = w * wbits;  // windows may straddle a 64-bit limb
+      u64 d = e[bit0 / 64] >> (bit0 % 64);
+      if (bit0 % 64 + wbits > 64 && bit0 / 64 + 1 < EL)
+        d |= e[bit0 / 64 + 1] << (64 - bit0 % 64);
+      d &= mask;
       mont_mul(acc, acc, T(w, (int)d), n, n0inv, L);
     }
     mont_mul(outs + (size_t)m * L, acc, onev, n, n0inv, L);
@@ -390,13 +502,120 @@ int fsdkr_modexp_shared(const u64 *base, const u64 *exps, const u64 *n,
 
   // same wipe discipline as fsdkr_modexp: the table and constants can
   // reconstruct base/modulus state (secret on prover-side uses)
-  secure_wipe(table, W * 16 * L);
+  secure_wipe(table, W * D * L);
   delete[] table;
   secure_wipe(b, L);
   secure_wipe(pw, L);
   secure_wipe(acc, L);
   secure_wipe(one_m, L);
   secure_wipe(r2, L);
+  return 0;
+}
+
+// ABI-stable 4-bit-window entry point (older bridges / capture tooling)
+int fsdkr_modexp_shared(const u64 *base, const u64 *exps, const u64 *n,
+                        u64 *outs, int M, int L, int EL) {
+  return fsdkr_modexp_shared_w(base, exps, n, outs, M, L, EL, 4);
+}
+
+// ---------------------------------------------------------------------------
+// Joint (Straus/Shamir) multi-exponentiation: rows of k terms sharing one
+// modulus per row,
+//
+//   outs[r] = prod_t bases[r*k+t] ^ exps[r*k+t]  mod mods[r].
+//
+// One interleaved windowed ladder per row: the squaring chain — the
+// dominant cost of a full-width modexp — is paid ONCE for the whole
+// product instead of once per term, and each wbits-wide window costs one
+// table multiply per *active* term. ebits[t] (k entries, launch-wide)
+// caps term t's window count: widths are column-level shape information
+// (bucketed by the caller from public wire-domain bounds), so the
+// schedule is data-independent — every row performs the identical
+// multiply sequence, and a zero window digit multiplies by the
+// Montgomery one (constant cost), same discipline as the comb kernel.
+//
+// Layout: bases rows*k*L, exps rows*k*EL (uniform EL, little-endian),
+// mods/outs rows*L. k <= MAXK; EL capped like the comb (adversarial
+// widths are gated upstream; this is the allocation backstop).
+
+static const int MAXK = 8;
+
+int fsdkr_multi_modexp_batch(const u64 *bases, const u64 *exps,
+                             const u64 *mods, u64 *outs, const int *ebits,
+                             int rows, int k, int L, int EL, int wbits) {
+  if (L <= 0 || L > MAXL || EL <= 0 || EL > 2 * MAXL || rows <= 0 ||
+      k <= 0 || k > MAXK || wbits < 1 || wbits > 6)
+    return -1;
+  const int D = 1 << wbits;
+  int W = 0;       // shared chain depth: max window count over terms
+  int Wt[MAXK];    // per-term window counts
+  for (int t = 0; t < k; t++) {
+    if (ebits[t] <= 0 || ebits[t] > EL * 64)
+      return -1;
+    Wt[t] = (ebits[t] + wbits - 1) / wbits;
+    if (Wt[t] > W)
+      W = Wt[t];
+  }
+  for (int r = 0; r < rows; r++)
+    if (!(mods[(size_t)r * L] & 1))
+      return -1;
+
+  u64 *table = new (std::nothrow) u64[(size_t)k * D * L];
+  if (!table)
+    return -1;
+  auto T = [&](int t, int d) { return table + ((size_t)t * D + d) * L; };
+
+  u64 one_m[MAXL], r2[MAXL], b[MAXL], base_m[MAXL], acc[MAXL], onev[MAXL];
+  std::memset(onev, 0, sizeof(u64) * MAXL);
+  onev[0] = 1;
+  for (int r = 0; r < rows; r++) {
+    const u64 *n = mods + (size_t)r * L;
+    const u64 n0inv = mont_n0inv(n[0]);
+    mont_constants(n, L, one_m, r2);
+
+    for (int t = 0; t < k; t++) {
+      std::memcpy(b, bases + ((size_t)r * k + t) * L, sizeof(u64) * L);
+      while (cmp_limbs(b, n, L) >= 0)
+        sub_limbs(b, b, n, L);
+      mont_mul(base_m, b, r2, n, n0inv, L);
+      std::memcpy(T(t, 0), one_m, sizeof(u64) * L);
+      std::memcpy(T(t, 1), base_m, sizeof(u64) * L);
+      for (int d = 2; d < D; d++) {
+        if (d % 2 == 0)
+          mont_sqr(T(t, d), T(t, d / 2), n, n0inv, L);
+        else
+          mont_mul(T(t, d), T(t, d - 1), base_m, n, n0inv, L);
+      }
+    }
+
+    const u64 mask = (u64)D - 1;
+    std::memcpy(acc, one_m, sizeof(u64) * L);
+    for (int w = W - 1; w >= 0; w--) {
+      if (w != W - 1) // acc is still one at the top window
+        for (int s = 0; s < wbits; s++)
+          mont_sqr(acc, acc, n, n0inv, L);
+      for (int t = 0; t < k; t++) {
+        if (w >= Wt[t])
+          continue; // static per-launch schedule (ebits), not data
+        const u64 *e = exps + ((size_t)r * k + t) * EL;
+        int bit0 = w * wbits; // windows may straddle a 64-bit limb
+        u64 d = e[bit0 / 64] >> (bit0 % 64);
+        if (bit0 % 64 + wbits > 64 && bit0 / 64 + 1 < EL)
+          d |= e[bit0 / 64 + 1] << (64 - bit0 % 64);
+        d &= mask;
+        mont_mul(acc, acc, T(t, (int)d), n, n0inv, L);
+      }
+    }
+    mont_mul(outs + (size_t)r * L, acc, onev, n, n0inv, L);
+  }
+
+  secure_wipe(table, k * D * L);
+  delete[] table;
+  secure_wipe(b, MAXL);
+  secure_wipe(base_m, MAXL);
+  secure_wipe(acc, MAXL);
+  secure_wipe(one_m, MAXL); // one_m/r2 reconstruct the modulus
+  secure_wipe(r2, MAXL);
   return 0;
 }
 

@@ -12,7 +12,7 @@ against a `batch_powm(bases, exps, moduli) -> list[int]` callable:
 
 from __future__ import annotations
 
-from typing import Callable, List, Sequence
+from typing import Callable, List, Optional, Sequence
 
 from ..config import ProtocolConfig
 
@@ -287,6 +287,290 @@ def tpu_powm_shared(bases, exps_per_group, moduli) -> List[List[int]]:
 _SHARED_MIN_ROWS = 4
 
 
+def multiexp_enabled() -> bool:
+    """FSDKR_MULTIEXP gates the joint multi-exponentiation planner: =0
+    reverts every caller (verifier equations, prover columns) to the
+    per-term column path for A/B isolation. Read at call time so the
+    bench battery can toggle it per step."""
+    return _os.environ.get("FSDKR_MULTIEXP", "1").lower() not in (
+        "0", "off", "false", "no",
+    )
+
+
+def batch_base_inv(values, moduli):
+    """Montgomery-trick batched modular inversion on the host: rows group
+    by modulus, one `pow(prod, -1, m)` per group plus ~3 bigint mulmods
+    per row (CPython bigint mulmul is C-speed; the serial `pow(v,-1,m)`
+    this replaces costs 0.5-1.7 ms per row at protocol widths). Returns
+    one entry per row; a non-invertible value poisons only its own group,
+    which falls back to per-row inversion and reports None for the bad
+    rows — the caller decides the failure semantics (the verifier fails
+    the row exactly as the host oracle does).
+
+    This is the host-side sibling of the device product tree
+    (ops.montgomery.batch_mod_inv_grouped, used by the column path's
+    result inversions): both implement the same group-by-modulus /
+    poison-only-own-group policy, and the joint/column verdict-identity
+    guarantee (tests/test_multiexp.py) depends on the two staying in
+    semantic lockstep."""
+    groups: dict = {}
+    for i, m in enumerate(moduli):
+        groups.setdefault(m, []).append(i)
+    out: List = [None] * len(values)
+    for m, idxs in groups.items():
+        if m <= 1:
+            continue
+        # prefix products: pref[j] = v_0 * ... * v_{j-1} mod m
+        pref = [1] * (len(idxs) + 1)
+        for j, i in enumerate(idxs):
+            pref[j + 1] = pref[j] * (values[i] % m) % m
+        try:
+            inv = pow(pref[-1], -1, m)
+        except ValueError:  # some row not invertible: per-row fallback
+            for i in idxs:
+                try:
+                    out[i] = pow(values[i] % m, -1, m)
+                except ValueError:
+                    out[i] = None
+            continue
+        for j in range(len(idxs) - 1, -1, -1):
+            out[idxs[j]] = pref[j] * inv % m
+            inv = inv * (values[idxs[j]] % m) % m
+    return out
+
+
+def _joint_rows(bases_rows, exps_rows, moduli, device: bool) -> List[int]:
+    """Straus joint ladders for rows of >= 2 per-row-base terms, bucketed
+    by (term count, modulus limb class) per launch. Exponents must be
+    non-negative (negatives are folded by multi_powm)."""
+    from ..ops.limbs import bucket_exp_bits, limbs_for_bits
+
+    out: List = [None] * len(moduli)
+    # bucket by (term count, modulus limb class, per-term width classes):
+    # a launch's shared chain is as deep as its widest term and each term
+    # position's window count follows the launch-wide max, so fusing rows
+    # of different width shapes would inflate the narrow ones (same
+    # pricing rule as powm_columns)
+    buckets: dict = {}
+    for i, (bs, es, m) in enumerate(zip(bases_rows, exps_rows, moduli)):
+        key = (
+            len(bs),
+            limbs_for_bits(m.bit_length()),
+            tuple(bucket_exp_bits([e_t]) for e_t in es),
+        )
+        buckets.setdefault(key, []).append(i)
+    for (k, _limbs, _widths), idxs in buckets.items():
+        b = [tuple(bases_rows[i]) for i in idxs]
+        e = [tuple(exps_rows[i]) for i in idxs]
+        m = [moduli[i] for i in idxs]
+        if device:
+            res = _device_joint_launch(b, e, m, k)
+        else:
+            from .. import native
+
+            res = native.multi_modexp_batch(b, e, m)
+        for i, v in zip(idxs, res):
+            out[i] = v
+    return out
+
+
+def _device_joint_launch(bases_rows, exps_rows, moduli, k) -> List[int]:
+    """One padded device multi-exp launch (CIOS or RNS by row count),
+    mirroring tpu_powm's routing/padding."""
+    from ..ops.limbs import bucket_exp_bits, limbs_for_bits
+    from ..utils.roofline import generic_modexp_macs, montmul_macs
+    from ..utils.trace import get_tracer
+
+    rows = len(moduli)
+    if rows > _MAX_ROWS:  # HBM tiling: sequential launches
+        out: List[int] = []
+        for lo in range(0, rows, _MAX_ROWS):
+            hi = lo + _MAX_ROWS
+            out += _device_joint_launch(
+                bases_rows[lo:hi], exps_rows[lo:hi], moduli[lo:hi], k
+            )
+        return out
+    pad = _pad_pow2(rows) - rows
+    bases_rows = list(bases_rows) + [(1,) * k] * pad
+    exps_rows = list(exps_rows) + [(0,) * k] * pad
+    moduli = list(moduli) + [3] * pad
+    width = max(m.bit_length() for m in moduli)
+    exp_bits = tuple(
+        bucket_exp_bits([e[t] for e in exps_rows]) for t in range(k)
+    )
+    kk = limbs_for_bits(width)
+    # the shared chain is as deep as the widest term; every further term
+    # adds only its own window lookups (+ table build) on top
+    extra = sorted(exp_bits, reverse=True)[1:]
+    get_tracer().add_macs(
+        generic_modexp_macs(len(moduli), max(exp_bits), kk)
+        + sum(eb // 4 + 15 for eb in extra) * len(moduli) * montmul_macs(kk)
+    )
+    if len(moduli) >= _RNS_MIN_ROWS:
+        for cls in _RNS_WIDTH_CLASSES:
+            if width <= cls:
+                from ..ops.rns import rns_multi_modexp
+
+                return rns_multi_modexp(
+                    bases_rows, exps_rows, moduli, cls, exp_bits, mesh=_MESH
+                )[:rows]
+    from ..ops.montgomery import multi_modexp
+
+    return multi_modexp(
+        bases_rows, exps_rows, moduli, kk, exp_bits,
+        ctx=_cached_ctx(moduli, kk), mesh=_MESH,
+    )[:rows]
+
+
+def multi_powm(bases_rows, exps_rows, moduli, device: Optional[bool] = None):
+    """Joint multi-exponentiation rows: prod_t bases[r][t]^exps[r][t] mod
+    moduli[r], each term routed to the engine that prices it best:
+
+    - negative exponents fold into the ladder by inverting the base once
+      (batch_base_inv; a non-invertible base raises ValueError — callers
+      needing per-row failure semantics pre-fold and gate themselves);
+    - terms whose (base, modulus) pair repeats across >= _SHARED_MIN_ROWS
+      rows ride the fixed-base comb (their squaring chain is already
+      amortized per group, which a per-row joint ladder cannot beat);
+    - rows left with >= 2 per-row terms ride the Straus joint ladder
+      (one shared squaring chain, k window lookups per window);
+    - rows left with 1 term ride the generic windowed kernel, fused by
+      exponent width;
+    - per-row recombination of the parts happens here (batched modmul on
+      the device path, C-speed bigint mulmod on the host path), so the
+      planner's callers never submit recombination columns.
+
+    This is algebraically exact — no random linear combination, no
+    soundness assumption on the (adversarial) moduli; see SECURITY.md.
+    """
+    rows = len(moduli)
+    if rows == 0:
+        return []
+    if device is None:
+        device = _device_powm()
+
+    # fold negative exponents: invert those bases, batched per modulus
+    neg_idx = [
+        (i, t)
+        for i, es in enumerate(exps_rows)
+        for t, e_t in enumerate(es)
+        if e_t < 0
+    ]
+    if neg_idx:
+        bases_rows = [list(bs) for bs in bases_rows]
+        exps_rows = [list(es) for es in exps_rows]
+        invs = batch_base_inv(
+            [bases_rows[i][t] for i, t in neg_idx],
+            [moduli[i] for i, _ in neg_idx],
+        )
+        for (i, t), inv in zip(neg_idx, invs):
+            if inv is None:
+                raise ValueError(
+                    "multi_powm: negative exponent with non-invertible base"
+                )
+            bases_rows[i][t] = inv
+            exps_rows[i][t] = -exps_rows[i][t]
+
+    # shared-base detection across all (row, term) instances; groups
+    # split by exponent width class as well — the comb's per-row lookup
+    # count follows the group's widest exponent, so a 256-bit share
+    # column must not ride a 2048-bit nonce column's window count
+    from ..ops.limbs import bucket_exp_bits
+
+    counts: dict = {}
+    for i, (bs, es, m) in enumerate(zip(bases_rows, exps_rows, moduli)):
+        for t, (b, e_t) in enumerate(zip(bs, es)):
+            counts.setdefault((b, m, bucket_exp_bits([e_t])), []).append(
+                (i, t)
+            )
+    comb_groups = [
+        (key, inst)
+        for key, inst in counts.items()
+        if len(inst) >= _SHARED_MIN_ROWS
+    ]
+
+    parts: List[List[int]] = [[] for _ in range(rows)]  # factors per row
+    if comb_groups:
+        g_bases = [key[0] for key, _ in comb_groups]
+        g_exps = [
+            [exps_rows[i][t] for i, t in inst] for _, inst in comb_groups
+        ]
+        g_mods = [key[1] for key, _ in comb_groups]
+        if device:
+            res = tpu_powm_shared(g_bases, g_exps, g_mods)
+        else:  # host engine: native fixed-base comb per group
+            from .. import native
+
+            res = [
+                native.modexp_shared(b, es, m) if es else []
+                for b, es, m in zip(g_bases, g_exps, g_mods)
+            ]
+        for (_, inst), vals in zip(comb_groups, res):
+            for (i, t), v in zip(inst, vals):
+                parts[i].append(v)
+        comb_instances = {it for _, inst in comb_groups for it in inst}
+    else:
+        comb_instances = set()
+
+    loners: List[List[int]] = [[] for _ in range(rows)]  # term idx per row
+    for i, bs in enumerate(bases_rows):
+        for t in range(len(bs)):
+            if (i, t) not in comb_instances:
+                loners[i].append(t)
+
+    joint_idx = [i for i in range(rows) if len(loners[i]) >= 2]
+    single_idx = [i for i in range(rows) if len(loners[i]) == 1]
+    if joint_idx:
+        res = _joint_rows(
+            [[bases_rows[i][t] for t in loners[i]] for i in joint_idx],
+            [[exps_rows[i][t] for t in loners[i]] for i in joint_idx],
+            [moduli[i] for i in joint_idx],
+            device,
+        )
+        for i, v in zip(joint_idx, res):
+            parts[i].append(v)
+    if single_idx:
+        # fuse by exponent-width/limb class exactly like powm_columns
+        from ..ops.limbs import bucket_exp_bits, limbs_for_bits
+
+        buckets: dict = {}
+        for i in single_idx:
+            (t,) = loners[i]
+            e = exps_rows[i][t]
+            w = (bucket_exp_bits([e]), limbs_for_bits(moduli[i].bit_length()))
+            buckets.setdefault(w, []).append((i, t))
+        gen = tpu_powm if device else host_powm
+        for pairs_ in buckets.values():
+            res = gen(
+                [bases_rows[i][t] for i, t in pairs_],
+                [exps_rows[i][t] for i, t in pairs_],
+                [moduli[i] for i, _ in pairs_],
+            )
+            for (i, _), v in zip(pairs_, res):
+                parts[i].append(v)
+
+    # per-row recombination
+    max_parts = max(len(p) for p in parts)
+    if max_parts == 1:
+        return [p[0] for p in parts]
+    if not device:
+        return [
+            _prod_mod(p, m) for p, m in zip(parts, moduli)
+        ]
+    acc = [p[0] for p in parts]
+    for step in range(1, max_parts):
+        nxt = [p[step] if len(p) > step else 1 for p in parts]
+        acc = tpu_modmul(acc, nxt, moduli)
+    return acc
+
+
+def _prod_mod(factors, m):
+    acc = factors[0] % m
+    for f in factors[1:]:
+        acc = acc * f % m
+    return acc
+
+
 def tpu_powm_grouped(bases, exps, moduli) -> List[int]:
     """Like tpu_powm, but rows sharing a (base, modulus) pair are routed
     through the fixed-base comb kernel; loner rows take the generic path.
@@ -343,6 +627,11 @@ def powm_columns(powm: BatchPowm, *columns):
     with a mod-n^2 (4096-bit) column would pay ~4x per modmul. Columns
     matching on both still share one launch (row count is nearly free
     next to depth).
+
+    A column whose bases/exps entries are TUPLES is a joint multi-
+    exponentiation column (one product-of-powers per row): all such
+    columns pool into one multi_powm planning pass, which routes each
+    term to the comb / Straus / generic engine and recombines per row.
     """
     from ..ops.limbs import bucket_exp_bits, limbs_for_bits
 
@@ -355,6 +644,8 @@ def powm_columns(powm: BatchPowm, *columns):
     by_prefix: dict = {}  # cheap prefix -> [column indices]
     alias: dict = {}  # later column index -> first column index
     flat: dict = {}  # width class -> (bases, exps, moduli, [(col, lo, hi)])
+    multi: list = []  # (col, lo, hi) spans into the pooled multi rows
+    mb, me, mm = [], [], []  # pooled multi-exponentiation rows
     for col, (bases, exps, moduli) in enumerate(columns):
         prefix = (
             len(bases),
@@ -372,6 +663,12 @@ def powm_columns(powm: BatchPowm, *columns):
             alias[col] = dup
             continue
         by_prefix.setdefault(prefix, []).append(col)
+        if bases and isinstance(bases[0], (tuple, list)):
+            multi.append((col, len(mb), len(mb) + len(bases)))
+            mb += list(bases)
+            me += list(exps)
+            mm += list(moduli)
+            continue
         w = (
             bucket_exp_bits(exps),
             limbs_for_bits(max(m.bit_length() for m in moduli)) if moduli else 0,
@@ -386,6 +683,15 @@ def powm_columns(powm: BatchPowm, *columns):
     for b, e, m, spans in flat.values():
         res = powm(b, e, m)
         for col, lo, hi in spans:
+            out[col] = res[lo:hi]
+    if multi:
+        # host backend always takes host engines; the tpu backend follows
+        # the platform routing (native core on XLA:CPU, kernels on chip)
+        res = multi_powm(
+            mb, me, mm,
+            device=False if powm is host_powm else _device_powm(),
+        )
+        for col, lo, hi in multi:
             out[col] = res[lo:hi]
     for col, dup in alias.items():
         out[col] = list(out[dup])  # fresh list: no aliasing across columns

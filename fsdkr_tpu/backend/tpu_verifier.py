@@ -83,9 +83,18 @@ class TpuBatchVerifier(BatchVerifier):
         apply_mesh(config)
 
     # ------------------------------------------------------------------
-    def _pdl_prepare(self, items):
-        """Recompute challenges; return (the family's 5 modexp columns,
+    def _pdl_prepare(self, items, joint: bool = False):
+        """Recompute challenges; return (the family's modexp columns,
         carry state for _pdl_finish). Column order matches _pdl_finish.
+
+        With joint=True (FSDKR_MULTIEXP), the two mod-n^2 columns and
+        their recombination collapse into ONE joint multi-exponentiation
+        row per item — u2 ?= gs1 * s2^n * c^{-e} (the reference's own
+        equation shape, `src/zk_pdl_with_slack.rs:140-149`) — sharing a
+        single squaring chain instead of two. c^{-1} comes from a batched
+        host inversion; a non-invertible c (adversarial) sends just that
+        row through the column-exact per-row check in _pdl_finish, so
+        joint and column verdicts are bit-identical.
 
         Exponent-position proof fields (s1, s3) are attacker-chosen wire
         integers: a negative value would crash the limb encoder mid-batch
@@ -121,25 +130,86 @@ class TpuBatchVerifier(BatchVerifier):
         s3_col = [p.s3 if ok else 0 for (p, _), ok in zip(items, row_ok)]
         nn_mod = [st.ek.nn for _, st in items]
         nt_mod = [st.N_tilde for _, st in items]
-        cols = (
-            ([st.ciphertext for _, st in items], e_vec, nn_mod),
-            ([p.s2 for p, _ in items], [st.ek.n for _, st in items], nn_mod),
+        nt_cols = (
             ([p.z for p, _ in items], e_vec, nt_mod),
             ([st.h1 for _, st in items], s1_col, nt_mod),
             ([st.h2 for _, st in items], s3_col, nt_mod),
         )
-        return cols, (e_vec, nn_mod, nt_mod, row_ok)
+        if not joint:
+            cols = (
+                ([st.ciphertext for _, st in items], e_vec, nn_mod),
+                ([p.s2 for p, _ in items], [st.ek.n for _, st in items], nn_mod),
+            ) + nt_cols
+            return cols, (e_vec, nn_mod, nt_mod, row_ok, None)
+        from .powm import batch_base_inv
+
+        need = [
+            i for i in range(len(items)) if row_ok[i] and e_vec[i] != 0
+        ]
+        with phase("pdl.base_inv", items=len(need)):
+            invs = batch_base_inv(
+                [items[i][1].ciphertext for i in need],
+                [nn_mod[i] for i in need],
+            )
+        c_inv = [1] * len(items)
+        inv_fail = [False] * len(items)
+        for i, v in zip(need, invs):
+            if v is None:
+                inv_fail[i] = True  # column-exact per-row check in finish
+            else:
+                c_inv[i] = v
+        live = [
+            ok and not fail for ok, fail in zip(row_ok, inv_fail)
+        ]
+        multi = (
+            [
+                (p.s2 % st.ek.nn if lv else 1, ci)
+                for (p, st), ci, lv in zip(items, c_inv, live)
+            ],
+            [
+                (st.ek.n if lv else 0, e if lv else 0)
+                for (_, st), e, lv in zip(items, e_vec, live)
+            ],
+            nn_mod,
+        )
+        cols = nt_cols + (multi,)
+        return cols, (e_vec, nn_mod, nt_mod, row_ok, inv_fail)
 
     def _pdl_finish(self, items, state, results):
-        """Combine the 5 modexp column results into per-row verdicts."""
-        e_vec, nn_mod, nt_mod, row_ok = state
-        c_e, s2_n, z_e, h1_s1, h2_s3 = results
+        """Combine the modexp column results into per-row verdicts."""
+        e_vec, nn_mod, nt_mod, row_ok, inv_fail = state
         with phase("pdl.combine", items=len(items)):
-            lhs2 = _modmul([p.u2 for p, _ in items], c_e, nn_mod)
             gs1 = [
                 (1 + (p.s1 % st.ek.n) * st.ek.n) % st.ek.nn for p, st in items
             ]
-            rhs2 = _modmul(gs1, s2_n, nn_mod)
+            if inv_fail is None:  # column path
+                c_e, s2_n, z_e, h1_s1, h2_s3 = results
+                lhs2 = _modmul([p.u2 for p, _ in items], c_e, nn_mod)
+                rhs2 = _modmul(gs1, s2_n, nn_mod)
+                ok2_vec = [
+                    lhs2[i] == rhs2[i] and row_ok[i] for i in range(len(items))
+                ]
+            else:  # joint path: u2 ?= gs1 * s2^n * c^{-e}
+                z_e, h1_s1, h2_s3, v2 = results
+                rhs2 = _modmul(gs1, v2, nn_mod)
+                ok2_vec = []
+                for i, (p, st) in enumerate(items):
+                    if inv_fail[i]:
+                        # adversarial c with gcd(c, n^2) > 1: evaluate the
+                        # column-form equality for exactly this row
+                        from ..core import intops
+
+                        lhs = p.u2 * intops.mod_pow(
+                            st.ciphertext % st.ek.nn, e_vec[i], st.ek.nn
+                        ) % st.ek.nn
+                        rhs = gs1[i] * intops.mod_pow(
+                            p.s2 % st.ek.nn, st.ek.n, st.ek.nn
+                        ) % st.ek.nn
+                        ok2_vec.append(lhs == rhs and row_ok[i])
+                    else:
+                        ok2_vec.append(
+                            p.u2 % st.ek.nn == rhs2[i] and row_ok[i]
+                        )
             lhs3 = _modmul([p.u3 for p, _ in items], z_e, nt_mod)
             rhs3 = _modmul(h1_s1, h2_s3, nt_mod)
 
@@ -149,7 +219,7 @@ class TpuBatchVerifier(BatchVerifier):
         out = []
         for idx, (proof, st) in enumerate(items):
             ok1 = ok1_vec[idx] and row_ok[idx]
-            ok2 = lhs2[idx] == rhs2[idx] and row_ok[idx]
+            ok2 = ok2_vec[idx]
             ok3 = lhs3[idx] == rhs3[idx] and row_ok[idx]
             out.append(None if (ok1 and ok2 and ok3) else (ok1, ok2, ok3))
         return out
@@ -157,10 +227,10 @@ class TpuBatchVerifier(BatchVerifier):
     def verify_pdl(self, items):
         if not items:
             return []
-        from .powm import powm_columns
+        from .powm import multiexp_enabled, powm_columns
 
-        cols, state = self._pdl_prepare(items)
-        with phase("pdl.modexp_columns", items=5 * len(items)):
+        cols, state = self._pdl_prepare(items, joint=multiexp_enabled())
+        with phase("pdl.modexp_columns", items=len(cols) * len(items)):
             results = powm_columns(_modexp, *cols)
         return self._pdl_finish(items, state, results)
 
@@ -260,8 +330,8 @@ class TpuBatchVerifier(BatchVerifier):
                 out[i] = vi
         return out
 
-    def _range_prepare(self, items):
-        """Return (the family's 5 modexp columns, carry state for
+    def _range_prepare(self, items, joint: bool = False):
+        """Return (the family's modexp columns, carry state for
         _range_finish). Column order matches _range_finish.
 
         Same out-of-domain gating as _pdl_prepare: exponent-position wire
@@ -269,7 +339,18 @@ class TpuBatchVerifier(BatchVerifier):
         staged with zeros and force-failed — never crash or inflate the
         batch. s1's q^3 slack bound (`src/range_proofs.rs:125`) is
         enforced HERE, pre-launch. Transcript fields (z, cipher, s) are
-        gated non-negative for chain_int."""
+        gated non-negative for chain_int.
+
+        With joint=True (FSDKR_MULTIEXP) the verifier computes the
+        reference's own equation shapes directly — w = h1^s1 h2^s2
+        (z^{-1})^e and u = gs1 * s^n * c^{-e} — by inverting the BASES
+        once per row (batched host inversion) instead of exponentiating
+        and then inverting the results through the device product tree:
+        the mod-n^2 pair shares one squaring chain as a joint 2-term row
+        and range.batch_inv disappears from the launch plan. gcd(z, N~)
+        > 1 or gcd(c, n^2) > 1 fails the row exactly as the host oracle
+        (mod_inv -> None) and the column path (product-tree fallback)
+        do."""
         nn_mod = [ek.nn for _, _, ek, _ in items]
         nt_mod = [dlog.N for _, _, _, dlog in items]
         row_ok = [
@@ -291,30 +372,76 @@ class TpuBatchVerifier(BatchVerifier):
         s2_col = [
             p.s2 if ok else 0 for (p, _, _, _), ok in zip(items, row_ok)
         ]
-        return (
-            ([p.z for p, _, _, _ in items], e_vec, nt_mod),
+        comb_cols = (
             ([dlog.g for _, _, _, dlog in items], s1_col, nt_mod),
             ([dlog.ni for _, _, _, dlog in items], s2_col, nt_mod),
-            ([c for _, c, _, _ in items], e_vec, nn_mod),
-            (
-                [p.s for p, _, _, _ in items],
-                [ek.n for _, _, ek, _ in items],
-                nn_mod,
-            ),
-        ), (nn_mod, nt_mod, row_ok)
+        )
+        if not joint:
+            return (
+                ([p.z for p, _, _, _ in items], e_vec, nt_mod),
+            ) + comb_cols + (
+                ([c for _, c, _, _ in items], e_vec, nn_mod),
+                (
+                    [p.s for p, _, _, _ in items],
+                    [ek.n for _, _, ek, _ in items],
+                    nn_mod,
+                ),
+            ), (nn_mod, nt_mod, row_ok, None)
+        from .powm import batch_base_inv
+
+        need = [i for i in range(len(items)) if row_ok[i] and e_vec[i] != 0]
+        with phase("range.base_inv", items=2 * len(need)):
+            z_invs = batch_base_inv(
+                [items[i][0].z for i in need], [nt_mod[i] for i in need]
+            )
+            c_invs = batch_base_inv(
+                [items[i][1] for i in need], [nn_mod[i] for i in need]
+            )
+        z_inv = [1] * len(items)
+        c_inv = [1] * len(items)
+        inv_fail = [False] * len(items)
+        for i, zv, cv in zip(need, z_invs, c_invs):
+            if zv is None or cv is None:
+                inv_fail[i] = True  # verdict False, like the host oracle
+            else:
+                z_inv[i], c_inv[i] = zv, cv
+        live = [ok and not fail for ok, fail in zip(row_ok, inv_fail)]
+        e_live = [e if lv else 0 for e, lv in zip(e_vec, live)]
+        multi = (
+            [
+                (p.s % ek.nn if lv else 1, ci)
+                for (p, _, ek, _), ci, lv in zip(items, c_inv, live)
+            ],
+            [
+                (ek.n if lv else 0, e)
+                for (_, _, ek, _), e, lv in zip(items, e_live, live)
+            ],
+            nn_mod,
+        )
+        return (
+            (z_inv, e_live, nt_mod),
+        ) + comb_cols + (multi,), (nn_mod, nt_mod, row_ok, inv_fail)
 
     def _range_finish(self, items, mods, results):
-        nn_mod, nt_mod, row_ok = mods
-        z_e, h1_s1, h2_s2, c_e, s_n = results
+        nn_mod, nt_mod, row_ok, inv_fail = mods
+        if inv_fail is None:  # column path
+            z_e, h1_s1, h2_s2, c_e, s_n = results
+        else:
+            z_inv_e, h1_s1, h2_s2, v_u = results
 
         with phase("range.combine", items=len(items)):
             w_part = _modmul(h1_s1, h2_s2, nt_mod)
             gs1 = [(1 + p.s1 * ek.n) % ek.nn for p, _, ek, _ in items]
-            u_part = _modmul(gs1, s_n, nn_mod)
+            if inv_fail is None:
+                u_part = _modmul(gs1, s_n, nn_mod)
+            else:
+                w_vec = _modmul(w_part, z_inv_e, nt_mod)
+                u_vec = _modmul(gs1, v_u, nn_mod)
 
-        with phase("range.batch_inv", items=2 * len(items)):
-            z_e_inv_vec = self._batch_inv(z_e, nt_mod)
-            c_e_inv_vec = self._batch_inv(c_e, nn_mod)
+        if inv_fail is None:
+            with phase("range.batch_inv", items=2 * len(items)):
+                z_e_inv_vec = self._batch_inv(z_e, nt_mod)
+                c_e_inv_vec = self._batch_inv(c_e, nn_mod)
 
         with phase("range.challenge", items=len(items)):
             out = []
@@ -323,13 +450,20 @@ class TpuBatchVerifier(BatchVerifier):
                 if not row_ok[idx]:
                     out.append(False)
                     continue
-                z_e_inv = z_e_inv_vec[idx]
-                c_e_inv = c_e_inv_vec[idx]
-                if z_e_inv is None or c_e_inv is None:
-                    out.append(False)
-                    continue
-                w = w_part[idx] * z_e_inv % dlog.N
-                u = u_part[idx] * c_e_inv % ek.nn
+                if inv_fail is None:
+                    z_e_inv = z_e_inv_vec[idx]
+                    c_e_inv = c_e_inv_vec[idx]
+                    if z_e_inv is None or c_e_inv is None:
+                        out.append(False)
+                        continue
+                    w = w_part[idx] * z_e_inv % dlog.N
+                    u = u_part[idx] * c_e_inv % ek.nn
+                else:
+                    if inv_fail[idx]:
+                        out.append(False)
+                        continue
+                    w = w_vec[idx]
+                    u = u_vec[idx]
                 out.append(
                     alice_range._challenge(
                         ek.n, cipher, proof.z, u, w, self.config.hash_alg
@@ -341,31 +475,35 @@ class TpuBatchVerifier(BatchVerifier):
     def verify_range(self, items):
         if not items:
             return []
-        from .powm import powm_columns
+        from .powm import multiexp_enabled, powm_columns
 
-        cols, mods = self._range_prepare(items)
-        with phase("range.modexp_columns", items=5 * len(items)):
+        cols, mods = self._range_prepare(items, joint=multiexp_enabled())
+        with phase("range.modexp_columns", items=len(cols) * len(items)):
             results = powm_columns(_modexp, *cols)
         return self._range_finish(items, mods, results)
 
     def verify_pairs(self, pdl_items, range_items):
-        """Both pair-loop families through ONE fused launch set: all 10
-        modexp columns submitted together, so same-width columns across
-        families share launches (e.g. both 256-bit challenge columns).
-        Cuts the pair loop's sequential launch count roughly in half,
-        which dominates when small committees underfeed the chip."""
+        """Both pair-loop families through ONE fused launch set: every
+        modexp column submitted together, so same-width columns across
+        families share launches (e.g. both 256-bit challenge columns) —
+        and under FSDKR_MULTIEXP both families' mod-n^2 equations pool
+        into one joint multi-exponentiation launch (identical row shape:
+        [s, c^{-1}] with exponents [n, e]). Cuts the pair loop's
+        sequential launch count roughly in half, which dominates when
+        small committees underfeed the chip."""
         if not pdl_items or not range_items:
             return super().verify_pairs(pdl_items, range_items)
-        from .powm import powm_columns
+        from .powm import multiexp_enabled, powm_columns
 
-        pcols, state = self._pdl_prepare(pdl_items)
-        rcols, rmods = self._range_prepare(range_items)
-        n_rows = 5 * (len(pdl_items) + len(range_items))
+        joint = multiexp_enabled()
+        pcols, state = self._pdl_prepare(pdl_items, joint=joint)
+        rcols, rmods = self._range_prepare(range_items, joint=joint)
+        n_rows = len(pcols) * len(pdl_items) + len(rcols) * len(range_items)
         with phase("pairs.modexp_columns", items=n_rows):
             results = powm_columns(_modexp, *pcols, *rcols)
         return (
-            self._pdl_finish(pdl_items, state, results[:5]),
-            self._range_finish(range_items, rmods, results[5:]),
+            self._pdl_finish(pdl_items, state, results[: len(pcols)]),
+            self._range_finish(range_items, rmods, results[len(pcols) :]),
         )
 
     # ------------------------------------------------------------------

@@ -24,6 +24,7 @@ __all__ = [
     "modexp",
     "modexp_batch",
     "modexp_shared",
+    "multi_modexp_batch",
     "is_probable_prime",
 ]
 
@@ -34,9 +35,22 @@ _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc", "fsdkr_native
 _LIB = _loader.get_lib(
     os.path.abspath(_SRC),
     "_fsdkr_native",
-    ("fsdkr_modexp", "fsdkr_modexp_batch", "fsdkr_modexp_shared",
-     "fsdkr_miller_rabin"),
+    ("fsdkr_modexp", "fsdkr_modexp_w", "fsdkr_modexp_batch",
+     "fsdkr_modexp_batch_w", "fsdkr_modexp_shared", "fsdkr_modexp_shared_w",
+     "fsdkr_multi_modexp_batch", "fsdkr_miller_rabin"),
 )
+
+
+def _gen_window_bits(total_exp_bits: int, terms: int = 1) -> int:
+    """Window width for the generic/joint windowed ladders: lookups cost
+    total_exp_bits/w, the per-term tables 2^w - 2 multiplies each. w=6
+    wins for full-width exponents, w=4 for short challenge columns."""
+    best, best_cost = 4, None
+    for w in (4, 5, 6):
+        cost = total_exp_bits / w + terms * ((1 << w) - 2)
+        if best_cost is None or cost < best_cost:
+            best, best_cost = w, cost
+    return best
 
 
 def _get() -> Optional[ctypes.CDLL]:
@@ -100,7 +114,10 @@ def modexp(base: int, exp: int, mod: int) -> int:
     # the modulus and result are secret too on the Paillier-decrypt path
     # (mod = p^2; gcd(out - 1, N) = p), so all four buffers are wiped
     mod_buf = _to_buf([mod], L)
-    rc = lib.fsdkr_modexp(base_buf, exp_buf, mod_buf, out, L, EL)
+    rc = lib.fsdkr_modexp_w(
+        base_buf, exp_buf, mod_buf, out, L, EL,
+        _gen_window_bits(exp.bit_length()),
+    )
     if rc != 0:
         _wipe_buf(base_buf, exp_buf, mod_buf, out)
         return pow(base, exp, mod)
@@ -133,7 +150,10 @@ def modexp_batch(
     base_buf = _to_buf([b % m for b, m in zip(bases, mods)], L)
     exp_buf = _to_buf(list(exps), EL)
     mod_buf = _to_buf(list(mods), L)
-    rc = lib.fsdkr_modexp_batch(base_buf, exp_buf, mod_buf, out, rows, L, EL)
+    rc = lib.fsdkr_modexp_batch_w(
+        base_buf, exp_buf, mod_buf, out, rows, L, EL,
+        _gen_window_bits(max(e.bit_length() for e in exps)),
+    )
     if rc != 0:
         # rows before the failing one have already written results
         _wipe_buf(base_buf, exp_buf, mod_buf, out)
@@ -143,13 +163,28 @@ def modexp_batch(
     return res
 
 
+def _comb_window_bits(ebits: int, m_rows: int) -> int:
+    """Comb window width minimizing per-row cost: lookups shrink as
+    ebits/w while the per-group table build ((2^w - 2 per window,
+    amortized over the group's rows) grows exponentially in w. At the
+    ring-Pedersen shape (M=256 rows, 2048-bit exponents) w=6 beats w=4
+    by ~22%; small pair groups (M~n) stay at w=4."""
+    best, best_cost = 4, None
+    for w in (4, 5, 6, 7, 8):
+        cost = (ebits / w) * (1.0 + ((1 << w) - 2) / m_rows)
+        if best_cost is None or cost < best_cost:
+            best, best_cost = w, cost
+    return best
+
+
 def modexp_shared(
     base: int, exps: Sequence[int], mod: int
 ) -> List[int]:
     """base^exps[i] mod mod via the fixed-base comb — the shared-base
     column shape of the verify loop (one squaring ladder amortized over
-    the whole group). Falls back to CPython pow when native is
-    unavailable or the modulus is even/oversized."""
+    the whole group; window width chosen by group shape). Falls back to
+    CPython pow when native is unavailable or the modulus is
+    even/oversized."""
     if not exps:
         return []
     lib = _get()
@@ -160,16 +195,91 @@ def modexp_shared(
     if EL > 2 * _MAX_LIMBS:  # comb table would be attacker-sized
         return [pow(base, e, mod) for e in exps]
     m_rows = len(exps)
+    wbits = _comb_window_bits(EL * 64, m_rows)
     out = (ctypes.c_uint64 * (m_rows * L))()
     base_buf = _to_buf([base % mod], L)
     exp_buf = _to_buf(list(exps), EL)
     mod_buf = _to_buf([mod], L)
-    rc = lib.fsdkr_modexp_shared(base_buf, exp_buf, mod_buf, out, m_rows, L, EL)
+    rc = lib.fsdkr_modexp_shared_w(
+        base_buf, exp_buf, mod_buf, out, m_rows, L, EL, wbits
+    )
     if rc != 0:
         _wipe_buf(base_buf, exp_buf, mod_buf, out)
         return [pow(base, e, mod) for e in exps]
     res = _from_buf(out, m_rows, L)
     _wipe_buf(base_buf, exp_buf, mod_buf, out)
+    return res
+
+
+def multi_modexp_batch(
+    bases: Sequence[Sequence[int]],
+    exps: Sequence[Sequence[int]],
+    mods: Sequence[int],
+) -> List[int]:
+    """Joint (Straus) multi-exponentiation: one interleaved windowed
+    ladder per row, prod_t bases[r][t]^exps[r][t] mod mods[r]. All rows
+    must carry the same term count k; exponents must be non-negative
+    (negative exponents are folded upstream by inverting the base —
+    backend.powm). The shared squaring chain is as deep as the widest
+    term's window count; per-term window counts follow the launch-wide
+    max width of that term position, so a k-term row of full-width
+    exponents costs ~(max_E + sum_E/4) Montgomery operations instead of
+    ~1.27 * sum_E. Falls back to row-wise CPython pow products when the
+    native core is unavailable or a modulus is even/oversized."""
+    if not bases:
+        return []
+    if not (len(bases) == len(exps) == len(mods)):
+        raise ValueError("batch length mismatch")
+    k = len(bases[0])
+    if any(len(b) != k or len(e) != k for b, e in zip(bases, exps)):
+        raise ValueError("multi-exponentiation rows must share a term count")
+    lib = _get()
+    L = max(_limbs_for(m) for m in mods)
+    # per-term exponent widths: launch-wide column shape (max bit length
+    # of the term position), so the shared chain and each term's window
+    # count are exact for the widest row and uniform across the launch
+    ebits = [
+        max(1, max(e[t].bit_length() for e in exps)) for t in range(k)
+    ]
+    EL = max(1, -(-max(ebits) // 64))
+    if (
+        lib is None
+        or L > _MAX_LIMBS
+        or k > 8
+        or EL > 2 * _MAX_LIMBS
+        or any(m % 2 == 0 or m <= 1 for m in mods)
+        or any(e_t < 0 for e in exps for e_t in e)
+    ):
+        out = []
+        for b, e, m in zip(bases, exps, mods):
+            acc = 1
+            for b_t, e_t in zip(b, e):
+                acc = acc * pow(b_t, e_t, m) % m
+            out.append(acc)
+        return out
+    rows = len(bases)
+    out_buf = (ctypes.c_uint64 * (rows * L))()
+    base_buf = _to_buf(
+        [b_t % m for b, m in zip(bases, mods) for b_t in b], L
+    )
+    exp_buf = _to_buf([e_t for e in exps for e_t in e], EL)
+    mod_buf = _to_buf(list(mods), L)
+    ebits_arr = (ctypes.c_int * k)(*ebits)
+    rc = lib.fsdkr_multi_modexp_batch(
+        base_buf, exp_buf, mod_buf, out_buf, ebits_arr, rows, k, L, EL,
+        _gen_window_bits(sum(ebits), k),
+    )
+    if rc != 0:
+        _wipe_buf(base_buf, exp_buf, mod_buf, out_buf)
+        out = []
+        for b, e, m in zip(bases, exps, mods):
+            acc = 1
+            for b_t, e_t in zip(b, e):
+                acc = acc * pow(b_t, e_t, m) % m
+            out.append(acc)
+        return out
+    res = _from_buf(out_buf, rows, L)
+    _wipe_buf(base_buf, exp_buf, mod_buf, out_buf)
     return res
 
 

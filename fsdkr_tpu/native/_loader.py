@@ -21,6 +21,25 @@ import threading
 from typing import Dict, Optional, Sequence
 
 
+def _cpu_feature_tag() -> str:
+    """Fingerprint of this host's CPU feature set, folded into the .so
+    cache filename. The artifacts are compiled with -march=native, and
+    VM instances of this environment share checkouts across hosts whose
+    CPUs differ slightly: an .so built under one feature set can SIGILL
+    under another — `platform.machine()` alone cannot see that. Same
+    discipline as the XLA compilation cache (bench.py _host_cpu_tag).
+    """
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    return hashlib.sha256(feats.encode()).hexdigest()[:10]
+    except OSError:
+        pass
+    return "nofeat"
+
+
 class NativeLib:
     """Lazy, thread-safe loader for one C++ source file.
 
@@ -50,7 +69,7 @@ class NativeLib:
             tag = hashlib.sha256(f.read()).hexdigest()[:16]
         return os.path.join(
             os.path.dirname(os.path.abspath(__file__)),
-            f"{self._prefix}_{tag}_{platform.machine()}.so",
+            f"{self._prefix}_{tag}_{platform.machine()}_{_cpu_feature_tag()}.so",
         )
 
     def _build(self) -> Optional[ctypes.CDLL]:

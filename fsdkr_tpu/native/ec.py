@@ -67,7 +67,13 @@ def _points_buf(points: Sequence[Affine]) -> ctypes.Array:
     return (ctypes.c_uint64 * (len(points) * 8)).from_buffer_copy(buf)
 
 
-def _scalars_buf(scalars: Sequence[int]) -> ctypes.Array:
+def _scalars_buf(scalars: Sequence[int]) -> Optional[ctypes.Array]:
+    """32-byte LE scalar staging. Returns None for any scalar outside
+    [0, 2^256) instead of raising OverflowError mid-batch: callers fall
+    back to the Python oracle, which owns the reduction/rejection
+    semantics for out-of-range values."""
+    if any(not (0 <= s < (1 << 256)) for s in scalars):
+        return None
     buf = bytearray(len(scalars) * 32)
     for i, s in enumerate(scalars):
         buf[i * 32 : (i + 1) * 32] = s.to_bytes(32, "little")
@@ -109,12 +115,18 @@ def horner_batch(
 def scalar_mul_batch(
     points: Sequence[Affine], scalars: Sequence[int]
 ) -> Optional[List[Affine]]:
-    """[s_i * P_i]; scalars must be reduced mod the group order."""
+    """[s_i * P_i]; scalars must be reduced mod the group order. A
+    length mismatch or out-of-range scalar returns None (Python oracle
+    fallback) — the C core reads exactly len(points) rows from both
+    buffers, so a short scalar buffer would be an out-of-bounds read and
+    silently wrong verdicts, never an exception."""
     lib = _get()
-    if lib is None or not points:
+    if lib is None or not points or len(scalars) != len(points):
         return None
     pts = _points_buf(points)
     sc = _scalars_buf(scalars)
+    if sc is None:
+        return None
     out = (ctypes.c_uint64 * (len(points) * 8))()
     rc = lib.fsdkr_ec_scalar_mul_batch(pts, sc, len(points), out)
     if rc != 0:
@@ -128,14 +140,22 @@ def lincomb2_batch(
     Q: Sequence[Affine],
     b: Sequence[int],
 ) -> Optional[List[Affine]]:
-    """[a_i*P_i + b_i*Q_i] — the PDL u1 shape. Scalars reduced mod q."""
+    """[a_i*P_i + b_i*Q_i] — the PDL u1 shape. Scalars reduced mod q.
+    All four sequences must match len(P); mismatches and out-of-range
+    scalars return None (see scalar_mul_batch: the C core trusts the
+    row count, so a short buffer is an out-of-bounds read)."""
     lib = _get()
     if lib is None or not P:
         return None
+    if not (len(a) == len(b) == len(Q) == len(P)):
+        return None
+    a_buf = _scalars_buf(a)
+    b_buf = _scalars_buf(b)
+    if a_buf is None or b_buf is None:
+        return None
     rc_out = (ctypes.c_uint64 * (len(P) * 8))()
     rc = lib.fsdkr_ec_lincomb2_batch(
-        _points_buf(P), _scalars_buf(a), _points_buf(Q), _scalars_buf(b),
-        len(P), rc_out,
+        _points_buf(P), a_buf, _points_buf(Q), b_buf, len(P), rc_out,
     )
     if rc != 0:
         return None

@@ -52,6 +52,7 @@ __all__ = [
     "bucket_exp_bits",
     "BatchModExp",
     "shared_base_modexp",
+    "multi_modexp",
 ]
 
 
@@ -358,6 +359,139 @@ def _shared_modexp_kernel(base, exp, n, n_prime, r2, one_mont, powers=None, *, e
     one = jnp.zeros_like(acc).at[:, 0].set(1)
     out = mont_mul_limbs(acc, one, n_rows, np_rows)
     return out.reshape(g, m, k)
+
+
+@partial(jax.jit, static_argnames=("exp_bits_seq",))
+def _multi_modexp_kernel(bases, exps, n, n_prime, r2, one_mont, *, exp_bits_seq):
+    """Joint (Straus) multi-exponentiation: result[b] = prod_t
+    bases[t, b]^exps[t, b] mod n[b].
+
+    bases: (T, B, K); exps: (T, B, EL) limbs; n/r2/one_mont: (B, K);
+    n_prime: (B,). exp_bits_seq: per-term bucketed widths, DESCENDING
+    (callers sort terms) and each a multiple of the window width.
+
+    One shared 4-bit squaring chain as deep as the widest term; per
+    window, one branchless 16-entry table multiply per *active* term —
+    term t's digits occupy the last exp_bits_seq[t]/4 windows of the
+    chain, so a k-term full-width row costs ~(E_max + sum E_t/4)
+    Montgomery products instead of the ~1.27 * sum E_t of k separate
+    ladders. The window schedule is static (widths are launch shape, not
+    data), so there is still no data-dependent control flow.
+    """
+    t_cnt, b_rows, k = bases.shape
+    assert all(eb % _WINDOW == 0 for eb in exp_bits_seq)
+    assert len(exp_bits_seq) == t_cnt
+    assert list(exp_bits_seq) == sorted(exp_bits_seq, reverse=True)
+
+    # all terms' window tables in one flattened (T*B)-row batch
+    nf = jnp.broadcast_to(n[None], (t_cnt, b_rows, k)).reshape(t_cnt * b_rows, k)
+    npf = jnp.broadcast_to(n_prime[None], (t_cnt, b_rows)).reshape(t_cnt * b_rows)
+    r2f = jnp.broadcast_to(r2[None], (t_cnt, b_rows, k)).reshape(t_cnt * b_rows, k)
+    onef = jnp.broadcast_to(one_mont[None], (t_cnt, b_rows, k)).reshape(
+        t_cnt * b_rows, k
+    )
+    base_m = mont_mul_limbs(bases.reshape(t_cnt * b_rows, k), r2f, nf, npf)
+
+    def build(j, table):
+        prev = table[j - 1]
+        table = table.at[j].set(mont_mul_limbs(prev, base_m, nf, npf))
+        return table
+
+    table0 = jnp.zeros((1 << _WINDOW, t_cnt * b_rows, k), _U32)
+    table0 = table0.at[0].set(onef).at[1].set(base_m)
+    table = lax.fori_loop(2, 1 << _WINDOW, build, table0).reshape(
+        1 << _WINDOW, t_cnt, b_rows, k
+    )
+
+    w_total = exp_bits_seq[0] // _WINDOW
+    idx = jnp.arange(1 << _WINDOW, dtype=_U32)[:, None, None]
+
+    def window_step(wi, acc, active):
+        """One shared window: 4 squarings then a lookup per active term.
+        wi counts from the TOP of the shared chain."""
+        for _ in range(_WINDOW):
+            acc = mont_mul_limbs(acc, acc, n, n_prime)
+        for t in active:
+            w_t = exp_bits_seq[t] // _WINDOW
+            # this term's digit index from its own MSB end (wi is traced,
+            # so the bit shift is a traced scalar: cast for the uint >>)
+            shift = exp_bits_seq[t] - _WINDOW * (wi - (w_total - w_t) + 1)
+            limb = lax.dynamic_index_in_dim(
+                exps[t], shift // LIMB_BITS, axis=1, keepdims=False
+            )
+            sh = (shift % LIMB_BITS).astype(_U32)
+            d = (limb >> sh) & ((1 << _WINDOW) - 1)
+            sel = jnp.sum(
+                jnp.where(d[None, :, None] == idx, table[:, t], jnp.uint32(0)),
+                axis=0,
+            )
+            acc = mont_mul_limbs(acc, sel, n, n_prime)
+        return acc
+
+    # segments: between consecutive distinct term widths the active-term
+    # set is constant, so the window loop runs as a static ladder of
+    # fori_loops (<= T segments) with the per-window term ops unrolled
+    acc = one_mont
+    starts = [w_total - eb // _WINDOW for eb in exp_bits_seq]  # ascending
+    bounds = sorted(set(starts + [w_total]))
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        active = tuple(t for t in range(t_cnt) if starts[t] <= lo)
+
+        def seg(wi, acc, _active=active):
+            return window_step(wi, acc, _active)
+
+        acc = lax.fori_loop(lo, hi, seg, acc)
+    one = jnp.zeros_like(acc).at[:, 0].set(1)
+    return mont_mul_limbs(acc, one, n, n_prime)
+
+
+def multi_modexp(
+    bases_rows: Sequence[Sequence[int]],
+    exps_rows: Sequence[Sequence[int]],
+    moduli: Sequence[int],
+    num_limbs: int,
+    exp_bits_seq: Sequence[int],
+    ctx=None,
+    mesh=None,
+) -> List[int]:
+    """Device joint multi-exponentiation: prod_t bases_rows[r][t] ^
+    exps_rows[r][t] mod moduli[r] through the CIOS kernel. exp_bits_seq
+    gives each term position's bucketed exponent width (launch shape);
+    terms are sorted widest-first internally so the shared chain depth is
+    the first entry."""
+    rows = len(moduli)
+    if rows == 0:
+        return []
+    k = len(exp_bits_seq)
+    order = sorted(range(k), key=lambda t: -exp_bits_seq[t])
+    eb = tuple(exp_bits_seq[t] for t in order)
+    el = -(-eb[0] // LIMB_BITS)
+    if ctx is None:
+        ctx = BatchModExp(moduli, num_limbs)
+    base_limbs = ints_to_limbs(
+        [bases_rows[r][t] % n for t in order for r, n in enumerate(ctx.ctx.moduli)],
+        num_limbs,
+    ).reshape(k, rows, num_limbs)
+    exp_limbs = ints_to_limbs(
+        [exps_rows[r][t] for t in order for r in range(rows)], el
+    ).reshape(k, rows, el)
+    args = (
+        jnp.asarray(base_limbs),
+        jnp.asarray(exp_limbs),
+        ctx._n,
+        ctx._n_prime,
+        ctx._r2,
+        ctx._one_mont,
+    )
+    if mesh is not None and rows % int(mesh.devices.size) == 0:
+        from ..parallel.shard_kernels import sharded_multi_modexp_fn
+
+        out = sharded_multi_modexp_fn(mesh, eb)(*args)
+    else:
+        out = _multi_modexp_kernel(*args, exp_bits_seq=eb)
+    res = limbs_to_ints(np.asarray(out))
+    wipe_array(exp_limbs, base_limbs)  # secret staging (SECURITY.md)
+    return res
 
 
 @jax.jit

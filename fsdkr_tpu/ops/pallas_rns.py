@@ -295,16 +295,17 @@ def _modexp_kernel_pallas(
         w = (limb >> (shift % LIMB_BITS)) & jnp.uint32((1 << WINDOW_BITS) - 1)
         for _ in range(WINDOW_BITS):
             acc = mul(acc, acc)
-        # Mosaic has no unsigned reductions: sum the masked table in
-        # int32 (residues are 16-bit, and 15 of the 16 terms are zero,
-        # so the signed detour is exact; the u32<->i32 hops are free)
-        sel = jnp.sum(
-            jnp.where(
-                w[None, :, :] == idx, table_ref[:], jnp.uint32(0)
-            ).astype(jnp.int32),
-            axis=0,
-        ).astype(_U32)
-        return mul(acc, sel)
+        # Mosaic has no unsigned — and on older versions no integer —
+        # reductions: collapse the one-hot-masked table with a static
+        # log2(16)-deep tree of elementwise adds instead of reduce_sum
+        # (15 of the 16 terms are zero, so plain adds are exact)
+        masked = jnp.where(w[None, :, :] == idx, table_ref[:], jnp.uint32(0))
+        terms = [masked[j] for j in range(1 << WINDOW_BITS)]
+        while len(terms) > 1:
+            terms = [
+                terms[i] + terms[i + 1] for i in range(0, len(terms), 2)
+            ]
+        return mul(acc, terms[0])
 
     acc = jax.lax.fori_loop(0, exp_bits // WINDOW_BITS, step, one_m)
     out_ref[:] = mul(acc, one)  # leave the Montgomery domain

@@ -62,7 +62,7 @@ from .limbs import (
 )
 from .montgomery import _normalize_carries
 
-__all__ = ["RNSBases", "rns_modexp", "rns_bases_for_bits"]
+__all__ = ["RNSBases", "rns_modexp", "rns_multi_modexp", "rns_bases_for_bits"]
 
 _U32 = jnp.uint32
 _LANE = 128  # matmul contraction chunk: k-slices of <= 128 keep f32 sums exact
@@ -915,6 +915,204 @@ def rns_modexp_shared(
                 for mi in range(len(exps_per_group[r]))
             ]
         )
+    return out
+
+
+@partial(jax.jit, static_argnames=("exp_bits_seq", "k", "pallas_mode"))
+def _rns_multi_modexp_kernel(
+    base_limbs, exp, a2n_limbs, c1_A, N_Bmr, consts_arrays, *, exp_bits_seq,
+    k, pallas_mode=0,
+):
+    """Joint (Straus) multi-exponentiation through the RNS/MXU pipeline:
+    result[b] = prod_t base[t, b]^exp[t, b] mod n[b], returned as residue
+    rows for the CRT exit.
+
+    base_limbs: (T, B, L); exp: (T, B, EL); a2n_limbs: (B, L); c1_A:
+    (B, k); N_Bmr: (B, k+1). exp_bits_seq: per-term bucketed widths,
+    descending. Same shared-squaring-chain schedule as the CIOS
+    _multi_modexp_kernel — one 4-bit chain as deep as the widest term,
+    one 16-entry table multiply per active term per window — with every
+    product an RNS MontMul (base extensions on the MXU; the fused Pallas
+    MontMul rides through `pallas_mode` exactly as in _rns_modexp_kernel).
+    """
+    (m_all, u_all, T1l, T1h, T2l, T2h, Ainv_B, c2_B, B_mod_A, Binv_r, Wl, Wh) = (
+        consts_arrays
+    )
+    t_cnt, b_rows, L = base_limbs.shape
+    c = 2 * k + 1
+
+    def consts_for(c1_rows, n_rows):
+        return dict(
+            k=k,
+            m_all=m_all,
+            u_all=u_all,
+            T1s=_resplit(T1l, T1h),
+            T2s=_resplit(T2l, T2h),
+            Ws=_resplit(Wl, Wh),
+            mA_mr=jnp.concatenate([m_all[:k], m_all[2 * k :]]),
+            uA_mr=jnp.concatenate([u_all[:k], u_all[2 * k :]]),
+            Ainv_B=Ainv_B,
+            c2_B=c2_B,
+            B_mod_A=B_mod_A,
+            Binv_r=Binv_r,
+            c1_A=c1_rows,
+            N_Bmr=n_rows,
+            pallas=_pallas_shared(consts_arrays) if pallas_mode else None,
+            pallas_interpret=pallas_mode == 2,
+        )
+
+    consts_b = consts_for(c1_A, N_Bmr)
+    c1_tb = jnp.broadcast_to(c1_A[None], (t_cnt, b_rows, k)).reshape(
+        t_cnt * b_rows, k
+    )
+    n_tb = jnp.broadcast_to(N_Bmr[None], (t_cnt, b_rows, k + 1)).reshape(
+        t_cnt * b_rows, k + 1
+    )
+    consts_tb = consts_for(c1_tb, n_tb)
+
+    a2n_res = _limbs_to_residues(a2n_limbs, consts_b)  # (B, C)
+    a2n_tb = jnp.broadcast_to(a2n_res[None], (t_cnt, b_rows, c)).reshape(
+        t_cnt * b_rows, c
+    )
+    base_res = _limbs_to_residues(base_limbs.reshape(t_cnt * b_rows, L), consts_tb)
+    base_m = _rns_mont_mul(base_res, a2n_tb, consts_tb)
+    one = jnp.ones((b_rows, c), _U32)
+    one_m = _rns_mont_mul(one, a2n_res, consts_b)
+    one_m_tb = jnp.broadcast_to(one_m[None], (t_cnt, b_rows, c)).reshape(
+        t_cnt * b_rows, c
+    )
+
+    def build(j, table):
+        prev = table[j - 1]
+        return table.at[j].set(_rns_mont_mul(prev, base_m, consts_tb))
+
+    table0 = jnp.zeros((1 << WINDOW_BITS, t_cnt * b_rows, c), _U32)
+    table0 = table0.at[0].set(one_m_tb).at[1].set(base_m)
+    table = lax.fori_loop(2, 1 << WINDOW_BITS, build, table0).reshape(
+        1 << WINDOW_BITS, t_cnt, b_rows, c
+    )
+
+    w_total = exp_bits_seq[0] // WINDOW_BITS
+    idx = jnp.arange(1 << WINDOW_BITS, dtype=_U32)[:, None, None]
+
+    def window_step(wi, acc, active):
+        for _ in range(WINDOW_BITS):
+            acc = _rns_mont_mul(acc, acc, consts_b)
+        for t in active:
+            w_t = exp_bits_seq[t] // WINDOW_BITS
+            shift = exp_bits_seq[t] - WINDOW_BITS * (wi - (w_total - w_t) + 1)
+            limb = lax.dynamic_index_in_dim(
+                exp[t], shift // LIMB_BITS, axis=1, keepdims=False
+            )
+            sh = (shift % LIMB_BITS).astype(_U32)
+            d = (limb >> sh) & ((1 << WINDOW_BITS) - 1)
+            sel = jnp.sum(
+                jnp.where(d[None, :, None] == idx, table[:, t], jnp.uint32(0)),
+                axis=0,
+            )
+            acc = _rns_mont_mul(acc, sel, consts_b)
+        return acc
+
+    acc = one_m
+    starts = [w_total - eb // WINDOW_BITS for eb in exp_bits_seq]
+    bounds = sorted(set(starts + [w_total]))
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        active = tuple(t for t in range(t_cnt) if starts[t] <= lo)
+
+        def seg(wi, acc, _active=active):
+            return window_step(wi, acc, _active)
+
+        acc = lax.fori_loop(lo, hi, seg, acc)
+    return _rns_mont_mul(acc, one, consts_b)  # leave the Montgomery domain
+
+
+def rns_multi_modexp(
+    bases_rows: Sequence[Sequence[int]],
+    exps_rows: Sequence[Sequence[int]],
+    moduli: Sequence[int],
+    value_bits: int,
+    exp_bits_seq: Sequence[int],
+    mesh=None,
+) -> List[int]:
+    """Joint multi-exponentiation rows through the RNS/MXU pipeline:
+    prod_t bases_rows[r][t]^exps_rows[r][t] mod moduli[r]. Moduli sharing
+    a factor with a channel prime fall back to host pow per row (same
+    policy as rns_modexp)."""
+    rows = len(moduli)
+    if rows == 0:
+        return []
+    k_terms = len(exp_bits_seq)
+    order = sorted(range(k_terms), key=lambda t: -exp_bits_seq[t])
+    eb = tuple(exp_bits_seq[t] for t in order)
+    num_limbs = -(-value_bits // LIMB_BITS)
+    rb = rns_bases_for_bits(value_bits, num_limbs)
+    k = rb.k
+    el = -(-eb[0] // LIMB_BITS)
+
+    a2n = []
+    c1 = np.zeros((rows, k), np.uint32)
+    n_bmr = np.zeros((rows, k + 1), np.uint32)
+    fallback_rows = {}
+    moduli = list(moduli)
+    bases_rows = [list(bs) for bs in bases_rows]
+    exps_rows = [list(es) for es in exps_rows]
+    for r, n in enumerate(moduli):
+        try:
+            for i, a in enumerate(rb.A_primes):
+                c1[r, i] = (-pow(n, -1, a)) % a * int(rb.Ai_inv[i]) % a
+            for j, b in enumerate(rb.B_primes):
+                n_bmr[r, j] = n % b
+            n_bmr[r, k] = n % rb.m_r
+        except ValueError:  # gcd(n, a_i) > 1: host fallback, neutral row
+            acc = 1
+            for b_t, e_t in zip(bases_rows[r], exps_rows[r]):
+                acc = acc * pow(b_t % n, e_t, n) % n
+            fallback_rows[r] = acc
+            moduli[r] = 3
+            bases_rows[r] = [1] * k_terms
+            exps_rows[r] = [0] * k_terms
+            c1[r, :] = [
+                (-pow(3, -1, a)) % a * int(rb.Ai_inv[i]) % a
+                for i, a in enumerate(rb.A_primes)
+            ]
+            n_bmr[r, :k] = [3 % b for b in rb.B_primes]
+            n_bmr[r, k] = 3 % rb.m_r
+        a2n.append(pow(rb.A, 2, moduli[r]))
+
+    base_limbs = ints_to_limbs(
+        [bases_rows[r][t] % moduli[r] for t in order for r in range(rows)],
+        num_limbs,
+    ).reshape(k_terms, rows, num_limbs)
+    exp_limbs = ints_to_limbs(
+        [exps_rows[r][t] for t in order for r in range(rows)], el
+    ).reshape(k_terms, rows, el)
+    args = (
+        jnp.asarray(base_limbs),
+        jnp.asarray(exp_limbs),
+        jnp.asarray(ints_to_limbs(a2n, num_limbs)),
+        jnp.asarray(c1),
+        jnp.asarray(n_bmr),
+        _prep_consts(rb),
+    )
+    pmode = _pallas_mode()
+    if mesh is not None and rows % int(mesh.devices.size) == 0:
+        from ..parallel.shard_kernels import sharded_rns_multi_modexp_fn
+
+        out_res = sharded_rns_multi_modexp_fn(mesh, eb, k, pmode)(*args)
+    else:
+        out_res = _rns_multi_modexp_kernel(
+            *args, exp_bits_seq=eb, k=k, pallas_mode=pmode
+        )
+    ec = rb.exit_consts
+    v_limbs = _crt_exit_kernel(out_res, *ec[:-1], k=k, lv=ec[-1])
+    vs = limbs_to_ints(np.asarray(v_limbs))
+    wipe_array(exp_limbs, base_limbs)
+    out = []
+    for r in range(rows):
+        if r in fallback_rows:
+            out.append(fallback_rows[r])
+        else:
+            out.append(vs[r] % moduli[r])
     return out
 
 

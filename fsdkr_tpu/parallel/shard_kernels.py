@@ -22,12 +22,32 @@ from jax.sharding import PartitionSpec as P
 
 __all__ = [
     "padded_rows",
+    "shard_map_compat",
     "sharded_modexp_fn",
     "sharded_modmul_fn",
     "sharded_shared_modexp_fn",
+    "sharded_multi_modexp_fn",
     "sharded_rns_modexp_fn",
     "sharded_rns_shared_modexp_fn",
+    "sharded_rns_multi_modexp_fn",
 ]
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """jax.shard_map across the jax versions this repo meets: the public
+    `jax.shard_map(check_vma=...)` API when present, the older
+    `jax.experimental.shard_map.shard_map(check_rep=...)` otherwise."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, check_vma=False, in_specs=in_specs,
+            out_specs=out_specs,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        fn, mesh=mesh, check_rep=False, in_specs=in_specs,
+        out_specs=out_specs,
+    )
 
 
 def padded_rows(rows: int, mesh) -> int:
@@ -42,11 +62,10 @@ def sharded_modexp_fn(mesh, exp_bits: int):
 
     row = tuple(mesh.axis_names)
     kernel = partial(_modexp_kernel.__wrapped__, exp_bits=exp_bits)
-    sm = jax.shard_map(
+    sm = shard_map_compat(
         kernel,
-        mesh=mesh,
-        check_vma=False,
-        in_specs=(
+        mesh,
+        (
             P(row, None),  # base
             P(row, None),  # exp
             P(row, None),  # n
@@ -54,7 +73,7 @@ def sharded_modexp_fn(mesh, exp_bits: int):
             P(row, None),  # r2
             P(row, None),  # one_mont
         ),
-        out_specs=P(row, None),
+        P(row, None),
     )
     return jax.jit(sm)
 
@@ -64,12 +83,11 @@ def sharded_modmul_fn(mesh):
     from ..ops.montgomery import _modmul_kernel
 
     row = tuple(mesh.axis_names)
-    sm = jax.shard_map(
+    sm = shard_map_compat(
         _modmul_kernel.__wrapped__,
-        mesh=mesh,
-        check_vma=False,
-        in_specs=(P(row, None),) * 3 + (P(row), P(row, None)),
-        out_specs=P(row, None),
+        mesh,
+        (P(row, None),) * 3 + (P(row), P(row, None)),
+        P(row, None),
     )
     return jax.jit(sm)
 
@@ -108,12 +126,61 @@ def sharded_shared_modexp_fn(mesh, exp_bits: int, with_powers: bool, tree_chunk:
             )
 
         in_specs = base_specs
-    sm = jax.shard_map(
+    sm = shard_map_compat(kernel, mesh, in_specs, P(row, None, None))
+    return jax.jit(sm)
+
+
+@lru_cache(maxsize=128)
+def sharded_multi_modexp_fn(mesh, exp_bits_seq: tuple):
+    """Joint multi-exponentiation kernel sharded over the ROW axis; the
+    term axis (leading) replicates its per-row slices alongside."""
+    from ..ops.montgomery import _multi_modexp_kernel
+
+    row = tuple(mesh.axis_names)
+    kernel = partial(
+        _multi_modexp_kernel.__wrapped__, exp_bits_seq=exp_bits_seq
+    )
+    sm = shard_map_compat(
         kernel,
-        mesh=mesh,
-        check_vma=False,
-        in_specs=in_specs,
-        out_specs=P(row, None, None),
+        mesh,
+        (
+            P(None, row, None),  # bases (T, B, K)
+            P(None, row, None),  # exps (T, B, EL)
+            P(row, None),  # n
+            P(row),  # n_prime
+            P(row, None),  # r2
+            P(row, None),  # one_mont
+        ),
+        P(row, None),
+    )
+    return jax.jit(sm)
+
+
+@lru_cache(maxsize=128)
+def sharded_rns_multi_modexp_fn(
+    mesh, exp_bits_seq: tuple, k: int, pallas_mode: int = 0
+):
+    from ..ops.rns import _rns_multi_modexp_kernel
+
+    row = tuple(mesh.axis_names)
+    kernel = partial(
+        _rns_multi_modexp_kernel.__wrapped__,
+        exp_bits_seq=exp_bits_seq,
+        k=k,
+        pallas_mode=pallas_mode,
+    )
+    sm = shard_map_compat(
+        kernel,
+        mesh,
+        (
+            P(None, row, None),  # base limbs (T, B, L)
+            P(None, row, None),  # exp limbs (T, B, EL)
+            P(row, None),  # a2n limbs
+            P(row, None),  # c1_A
+            P(row, None),  # N_Bmr
+            P(),  # shared constants (replicated pytree)
+        ),
+        P(row, None),
     )
     return jax.jit(sm)
 
@@ -129,11 +196,10 @@ def sharded_rns_modexp_fn(mesh, exp_bits: int, k: int, pallas_mode: int = 0):
         k=k,
         pallas_mode=pallas_mode,
     )
-    sm = jax.shard_map(
+    sm = shard_map_compat(
         kernel,
-        mesh=mesh,
-        check_vma=False,
-        in_specs=(
+        mesh,
+        (
             P(row, None),  # base limbs
             P(row, None),  # exp limbs
             P(row, None),  # a2n limbs
@@ -141,7 +207,7 @@ def sharded_rns_modexp_fn(mesh, exp_bits: int, k: int, pallas_mode: int = 0):
             P(row, None),  # N_Bmr
             P(),  # shared constants (replicated pytree)
         ),
-        out_specs=P(row, None),
+        P(row, None),
     )
     return jax.jit(sm)
 
@@ -165,11 +231,10 @@ def sharded_rns_shared_modexp_fn(
         device_ladder=device_ladder,
         tree_chunk=tree_chunk,
     )
-    sm = jax.shard_map(
+    sm = shard_map_compat(
         kernel,
-        mesh=mesh,
-        check_vma=False,
-        in_specs=(
+        mesh,
+        (
             P(None, row, None),  # powers (W, G, L)
             P(row, None, None),  # exp (G, M, EL)
             P(row, None),  # a2n (G, L)
@@ -177,6 +242,6 @@ def sharded_rns_shared_modexp_fn(
             P(row, None),  # N_Bmr (G, k+1)
             P(),  # shared constants
         ),
-        out_specs=P(row, None),
+        P(row, None),
     )
     return jax.jit(sm)

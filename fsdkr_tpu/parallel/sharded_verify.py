@@ -68,11 +68,12 @@ def sharded_modexp(
 
     row = tuple(mesh.axis_names)  # rows shard over every mesh axis
     kernel = partial(_modexp_kernel.__wrapped__, exp_bits=exp_bits)
-    sharded = jax.shard_map(
+    from .shard_kernels import shard_map_compat
+
+    sharded = shard_map_compat(
         kernel,
-        mesh=mesh,
-        check_vma=False,
-        in_specs=(
+        mesh,
+        (
             P(row, None),  # base
             P(row, None),  # exp
             P(row, None),  # n
@@ -80,7 +81,7 @@ def sharded_modexp(
             P(row, None),  # r2
             P(row, None),  # one_mont
         ),
-        out_specs=P(row, None),
+        P(row, None),
     )
     out = jax.jit(sharded)(
         jnp.asarray(base_limbs),
@@ -133,11 +134,12 @@ def sharded_verdict_step(
         failures = jax.lax.psum(jnp.sum(~ok), row)
         return ok, failures
 
-    sharded = jax.shard_map(
+    from .shard_kernels import shard_map_compat
+
+    sharded = shard_map_compat(
         step,
-        mesh=mesh,
-        check_vma=False,
-        in_specs=(
+        mesh,
+        (
             P(row, None),
             P(row, None),
             P(row, None),
@@ -146,7 +148,7 @@ def sharded_verdict_step(
             P(row, None),
             P(row, None),
         ),
-        out_specs=(P(row), P()),
+        (P(row), P()),
     )
     ok, failures = jax.jit(sharded)(
         jnp.asarray(base_limbs),

@@ -91,28 +91,44 @@ class AliceProof:
         beta = [intops.sample_unit(n) for n in nv]
         gamma = [secrets.randbelow(q3 * nt) for nt in ntv]
         rho = [secrets.randbelow(q * nt) for nt in ntv]
+        from ..backend.powm import multiexp_enabled
+
+        joint = multiexp_enabled()
         state = dict(
             avals=avals, rvals=rvals, alpha=alpha, beta=beta, gamma=gamma,
-            rho=rho, ntv=ntv, nv=nv, nnv=nnv, hash_alg=hash_alg,
+            rho=rho, ntv=ntv, nv=nv, nnv=nnv, hash_alg=hash_alg, joint=joint,
         )
-        cols = [
-            (h1v, avals, ntv),
-            (h2v, rho, ntv),
-            (h1v, alpha, ntv),
-            (h2v, gamma, ntv),
-            (beta, nv, nnv),
-        ]
+        if joint:
+            # z/w as joint multi-exponentiation rows (see
+            # PDLwSlackProof.prove_stage1): the mod_mul_col recombination
+            # moves into the planner's launch plan
+            cols = [
+                (list(zip(h1v, h2v)), list(zip(avals, rho)), ntv),
+                (list(zip(h1v, h2v)), list(zip(alpha, gamma)), ntv),
+                (beta, nv, nnv),
+            ]
+        else:
+            cols = [
+                (h1v, avals, ntv),
+                (h2v, rho, ntv),
+                (h1v, alpha, ntv),
+                (h2v, gamma, ntv),
+                (beta, nv, nnv),
+            ]
         return state, cols
 
     @staticmethod
     def generate_stage2(state, results, ciphers):
-        c1, c2, c3, c4, bn = results
         ntv, nv, nnv = state["ntv"], state["nv"], state["nnv"]
         alpha = state["alpha"]
         from ..core import paillier
 
-        z = intops.mod_mul_col(c1, c2, ntv)
-        w = intops.mod_mul_col(c3, c4, ntv)
+        if state.get("joint"):
+            z, w, bn = results
+        else:
+            c1, c2, c3, c4, bn = results
+            z = intops.mod_mul_col(c1, c2, ntv)
+            w = intops.mod_mul_col(c3, c4, ntv)
         u = paillier.combine_with_rn(alpha, bn, nv, nnv)  # Enc(alpha; beta)
         e = [
             _challenge(n, cipher, zi, ui, wi, state["hash_alg"])

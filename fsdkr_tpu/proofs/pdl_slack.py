@@ -110,38 +110,60 @@ class PDLwSlackProof:
 
     @staticmethod
     def prove_stage1(witnesses, h1v, h2v, ntv, nv, nnv, hash_alg=None):
-        """Sample nonces, return (state, columns): 4 commitment columns
-        mod N~ plus the beta^n column mod n^2."""
+        """Sample nonces, return (state, columns). Under FSDKR_MULTIEXP
+        the two mod-N~ commitment pairs are submitted as joint
+        multi-exponentiation rows (z = h1^x h2^rho, u3 = h1^alpha
+        h2^gamma per row) — the planner routes the shared h1/h2 terms
+        through the comb and recombines in-launch, so the host
+        mod_mul_col columns disappear; =0 keeps the per-term column
+        layout."""
         q = CURVE_ORDER
         q3 = q**3
         alpha = [secrets.randbelow(q3) for _ in ntv]
         beta = [1 + secrets.randbelow(n - 1) for n in nv]
         rho = [secrets.randbelow(q * nt) for nt in ntv]
         gamma = [secrets.randbelow(q3 * nt) for nt in ntv]
+        from ..backend.powm import multiexp_enabled
+
+        joint = multiexp_enabled()
         state = dict(
             witnesses=witnesses, alpha=alpha, beta=beta, rho=rho, gamma=gamma,
-            ntv=ntv, nv=nv, nnv=nnv, hash_alg=hash_alg,
+            ntv=ntv, nv=nv, nnv=nnv, hash_alg=hash_alg, joint=joint,
         )
-        cols = [
-            (h1v, [w.x.to_int() for w in witnesses], ntv),
-            (h2v, rho, ntv),
-            (h1v, alpha, ntv),
-            (h2v, gamma, ntv),
-            (beta, nv, nnv),
-        ]
+        if joint:
+            cols = [
+                (
+                    list(zip(h1v, h2v)),
+                    [(w.x.to_int(), r) for w, r in zip(witnesses, rho)],
+                    ntv,
+                ),
+                (list(zip(h1v, h2v)), list(zip(alpha, gamma)), ntv),
+                (beta, nv, nnv),
+            ]
+        else:
+            cols = [
+                (h1v, [w.x.to_int() for w in witnesses], ntv),
+                (h2v, rho, ntv),
+                (h1v, alpha, ntv),
+                (h2v, gamma, ntv),
+                (beta, nv, nnv),
+            ]
         return state, cols
 
     @staticmethod
     def prove_stage2(state, results, statements, device_ec: bool = False):
         """Combine stage-1 results, recompute challenges, return
         (state, columns): the r^e response column."""
-        c1, c2, c3, c4, bn = results
         ntv, nv, nnv = state["ntv"], state["nv"], state["nnv"]
         alpha = state["alpha"]
         from ..core import paillier
 
-        z = intops.mod_mul_col(c1, c2, ntv)
-        u3 = intops.mod_mul_col(c3, c4, ntv)
+        if state.get("joint"):
+            z, u3, bn = results
+        else:
+            c1, c2, c3, c4, bn = results
+            z = intops.mod_mul_col(c1, c2, ntv)
+            u3 = intops.mod_mul_col(c3, c4, ntv)
         u2 = paillier.combine_with_rn(alpha, bn, nv, nnv)  # Enc(alpha; beta)
         from ..core.secp256k1 import GENERATOR
 

@@ -27,9 +27,12 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def run_tiny_refresh(pallas_mode: str, mesh_shape=None):
+def run_tiny_refresh(pallas_mode: str, mesh_shape=None, multiexp: str = "1"):
     """One n=4 refresh at TEST_CONFIG size; returns captured calls."""
     os.environ["FSDKR_PALLAS"] = pallas_mode
+    # both planner modes must lower: =1 launches the joint multi-exp
+    # kernels (CIOS + RNS), =0 the per-term column kernels
+    os.environ["FSDKR_MULTIEXP"] = multiexp
     # force the TPU-platform routing: auto would send EC and modexp to
     # the host engines on this CPU host and the capture would never
     # reach the device kernels the preflight exists to lower
@@ -74,11 +77,20 @@ def main():
     from fsdkr_tpu.utils.aot_check import lower_for_tpu
 
     all_calls = []
-    for mode, mesh in (("0", None), ("1", None), ("0", (1,))):
-        log(f"--- capture pass: FSDKR_PALLAS={mode} mesh={mesh}")
-        calls = run_tiny_refresh(mode, mesh_shape=mesh)
+    for mode, mesh, multiexp in (
+        ("0", None, "1"),
+        ("1", None, "1"),
+        ("0", (1,), "1"),
+        ("0", None, "0"),
+    ):
+        log(
+            f"--- capture pass: FSDKR_PALLAS={mode} mesh={mesh} "
+            f"multiexp={multiexp}"
+        )
+        calls = run_tiny_refresh(mode, mesh_shape=mesh, multiexp=multiexp)
         log(f"    {len(calls)} jitted calls recorded")
         all_calls.extend(calls)
+    os.environ.pop("FSDKR_MULTIEXP", None)
     # The mesh pass executes the shard_map wrappers (API surface, e.g.
     # the __wrapped__ unwrap) but those wrappers are factory-built, not
     # module-level jits, so they are not re-lowered here: their Mosaic

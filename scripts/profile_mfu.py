@@ -36,15 +36,61 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def _merge_intervals_us(intervals):
+    """Total covered time of [start, end) microsecond intervals."""
+    total = 0.0
+    end = None
+    for s, e in sorted(intervals):
+        if end is None or s > end:
+            total += e - s
+            end = e
+        elif e > end:
+            total += e - end
+            end = e
+    return total
+
+
+def _leaf_intervals(intervals):
+    """Drop container intervals: trace events nest, and the umbrella
+    "step"/module events SPAN the ops they contain — including the
+    host-side gaps between launches, which are not device-busy time.
+    Keeping only leaves (intervals that contain no other interval) drops
+    the umbrellas wherever real op events exist, while a dump with only
+    umbrella events keeps them (they are leaves then)."""
+    ivs = sorted(intervals, key=lambda se: (se[0], -se[1]))
+    out = []
+    stack = []  # [start, end, has_child]
+
+    def flush(node):
+        if not node[2]:
+            out.append((node[0], node[1]))
+
+    for s, e in ivs:
+        while stack and stack[-1][1] <= s:
+            flush(stack.pop())
+        if stack:
+            stack[-1][2] = True  # current nests (or overlaps) into top
+        stack.append([s, e, False])
+    while stack:
+        flush(stack.pop())
+    return out
+
+
 def _parse_device_busy_s(trace_dir):
-    """Sum op durations on device tracks of the newest Perfetto dump.
+    """Busy time of the device tracks of the newest Perfetto dump.
 
     The profiler writes <dir>/plugins/profile/<run>/*.trace.json.gz with
     one process per hardware unit. Device tracks are the ones whose
     process name mentions the TPU core ("/device:TPU" or "TensorCore");
-    host/python threads are excluded. Overlapping events on one track do
-    not occur (ops serialize per core), so a plain sum is the busy time.
-    """
+    host/python threads are excluded. Busy time is the UNION of the LEAF
+    op intervals: xprof dumps interleave umbrella "step"/module events
+    that span the ops they contain (including host gaps between
+    launches) — different xprof versions put them on different tids, so
+    the old tid==0 heuristic either double-counted (steps on another
+    tid) or dropped real op time (ops on tid 0). Dropping containers
+    (_leaf_intervals) then merging overlaps (_merge_intervals_us) is
+    correct under any nesting/track layout and degrades to the plain sum
+    when nothing nests or overlaps (ops serialize per core)."""
     dumps = sorted(
         glob.glob(os.path.join(trace_dir, "plugins", "profile", "*",
                                "*.trace.json.gz")),
@@ -61,20 +107,14 @@ def _parse_device_busy_s(trace_dir):
             pname = ev.get("args", {}).get("name", "")
             if "TPU" in pname or "TensorCore" in pname:
                 device_pids.add(ev["pid"])
-    busy_us = 0.0
-    steps_us = 0.0
+    intervals = []
     for ev in events:
         if ev.get("ph") == "X" and ev.get("pid") in device_pids:
+            ts = float(ev.get("ts", 0.0))
             dur = float(ev.get("dur", 0.0))
-            # XLA emits a few umbrella "step" events spanning whole
-            # launches on a separate track line; they double-count the
-            # ops inside. Heuristic: tid 0 carries steps on xprof dumps.
-            if ev.get("tid") == 0:
-                steps_us += dur
-            else:
-                busy_us += dur
-    if busy_us == 0.0:
-        busy_us = steps_us  # dump had only umbrella events
+            if dur > 0:
+                intervals.append((ts, ts + dur))
+    busy_us = _merge_intervals_us(_leaf_intervals(intervals))
     return busy_us / 1e6 if busy_us else None
 
 

@@ -20,7 +20,11 @@ import jax  # noqa: E402
 
 # braces (required with the axon plugin installed)
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax: the XLA_FLAGS fallback above already forces 8 host devices
+    pass
 
 # The suite runs on the CPU platform, where auto EC and modexp routing
 # would send every hot path to the host oracle (fsdkr_tpu.config

@@ -1,0 +1,323 @@
+"""Differential parity suite for the joint multi-exponentiation
+(Straus/Shamir) engines, plus planner semantics and the FSDKR_MULTIEXP
+collect-level A/B identity.
+
+Three engines compute `prod_t bases[r][t]^exps[r][t] mod moduli[r]`:
+the native C++ interleaved ladder (csrc/fsdkr_native.cpp), the CIOS
+device kernel (ops.montgomery._multi_modexp_kernel) and the RNS/MXU
+kernel (ops.rns._rns_multi_modexp_kernel). Every one is checked against
+the CPython pow oracle over random k in {1..4}, mixed exponent widths,
+negative exponents (planner base-inversion folding), shared-modulus
+groups, and 768/2048/4096-bit moduli.
+"""
+
+import copy
+import dataclasses
+import random
+
+import pytest
+
+from fsdkr_tpu import native
+from fsdkr_tpu.backend import powm as powm_mod
+from fsdkr_tpu.backend.powm import (
+    batch_base_inv,
+    host_powm,
+    multi_powm,
+    powm_columns,
+)
+
+RNG = random.Random(0xF5DC)
+
+
+def _odd_mod(bits):
+    return RNG.getrandbits(bits) | (1 << (bits - 1)) | 1
+
+
+def _oracle_row(bases, exps, m):
+    acc = 1
+    for b, e in zip(bases, exps):
+        acc = acc * pow(b, e, m) % m
+    return acc
+
+
+def _random_rows(bits, widths, rows, shared_mod=False):
+    mods = (
+        [_odd_mod(bits)] * rows
+        if shared_mod
+        else [_odd_mod(bits) for _ in range(rows)]
+    )
+    bases = [tuple(RNG.randrange(1, m) for _ in widths) for m in mods]
+    exps = [
+        tuple(RNG.getrandbits(w) for w in widths) for _ in range(rows)
+    ]
+    return bases, exps, mods
+
+
+# ---------------------------------------------------------------------------
+# native engine
+
+
+@pytest.mark.skipif(not native.available(), reason="no native core")
+@pytest.mark.parametrize(
+    "bits,widths",
+    [
+        (768, (768, 256)),
+        (768, (768, 256, 17, 1)),
+        (2048, (2048, 256)),
+        (4096, (2048, 256, 256)),
+    ],
+)
+def test_native_multi_parity(bits, widths):
+    bases, exps, mods = _random_rows(bits, widths, rows=4)
+    got = native.multi_modexp_batch(bases, exps, mods)
+    for r in range(len(mods)):
+        assert got[r] == _oracle_row(bases[r], exps[r], mods[r])
+
+
+@pytest.mark.skipif(not native.available(), reason="no native core")
+def test_native_multi_edge_cases():
+    n = _odd_mod(768)
+    # zero exponents, base >= modulus, k=1
+    assert native.multi_modexp_batch([(n + 5, 3)], [(0, 0)], [n]) == [1]
+    assert native.multi_modexp_batch([(2,)], [(100,)], [n]) == [
+        pow(2, 100, n)
+    ]
+    # even modulus: pure-Python row fallback, still exact
+    assert native.multi_modexp_batch([(3, 5)], [(7, 2)], [1 << 700]) == [
+        pow(3, 7, 1 << 700) * 25 % (1 << 700)
+    ]
+
+
+@pytest.mark.skipif(not native.available(), reason="no native core")
+@pytest.mark.parametrize("m_rows", [3, 256])
+def test_native_comb_window_widths(m_rows):
+    """The comb picks its window width by group shape (w=4 small groups,
+    w=6 at ring-Pedersen-like groups); both must match the oracle,
+    including exponents that straddle 64-bit limb boundaries."""
+    n = _odd_mod(768)
+    base = RNG.randrange(1, n)
+    exps = [
+        RNG.getrandbits(RNG.choice([1, 63, 64, 65, 768, 1500]))
+        for _ in range(m_rows)
+    ]
+    assert native.modexp_shared(base, exps, n) == [
+        pow(base, e, n) for e in exps
+    ]
+
+
+# ---------------------------------------------------------------------------
+# planner (multi_powm): term routing, negative exponents, recombination
+
+
+@pytest.mark.parametrize("device", [False, True])
+def test_multi_powm_parity(device):
+    bases, exps, mods = _random_rows(768, (768, 256), rows=6)
+    got = multi_powm(bases, exps, mods, device=device)
+    for r in range(len(mods)):
+        assert got[r] == _oracle_row(bases[r], exps[r], mods[r])
+
+
+@pytest.mark.parametrize("device", [False, True])
+def test_multi_powm_negative_exponents(device):
+    rows = 5
+    m = _odd_mod(768)
+    mods = [m] * rows
+    import math
+
+    bases, exps = [], []
+    for _ in range(rows):
+        bs, es = [], []
+        for w, sign in ((768, 1), (256, -1)):
+            while True:
+                b = RNG.randrange(2, m)
+                if math.gcd(b, m) == 1:
+                    break
+            bs.append(b)
+            es.append(sign * RNG.getrandbits(w))
+        bases.append(tuple(bs))
+        exps.append(tuple(es))
+    got = multi_powm(bases, exps, mods, device=device)
+    for r in range(rows):
+        want = 1
+        for b, e in zip(bases[r], exps[r]):
+            want = want * pow(b, e, m) % m
+        assert got[r] == want
+
+
+def test_multi_powm_shared_base_comb_routing():
+    """Rows sharing (base, modulus) terms must route through the comb
+    and still recombine exactly (the prover stage-1 shape: h1^x h2^rho
+    per receiver group)."""
+    m = _odd_mod(768)
+    h1, h2 = RNG.randrange(2, m), RNG.randrange(2, m)
+    rows = 8  # >= _SHARED_MIN_ROWS so both terms ride the comb
+    bases = [(h1, h2)] * rows
+    exps = [
+        (RNG.getrandbits(256), RNG.getrandbits(1024)) for _ in range(rows)
+    ]
+    mods = [m] * rows
+    for device in (False, True):
+        got = multi_powm(bases, exps, mods, device=device)
+        for r in range(rows):
+            assert got[r] == _oracle_row(bases[r], exps[r], mods[r])
+
+
+def test_multi_powm_rns_path(monkeypatch):
+    """Force the RNS router threshold to zero so the joint rows take the
+    RNS/MXU kernel."""
+    monkeypatch.setattr(powm_mod, "_RNS_MIN_ROWS", 0)
+    bases, exps, mods = _random_rows(768, (768, 256), rows=4)
+    got = multi_powm(bases, exps, mods, device=True)
+    for r in range(len(mods)):
+        assert got[r] == _oracle_row(bases[r], exps[r], mods[r])
+
+
+def test_multi_powm_meshed():
+    from fsdkr_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    monkey = powm_mod._MESH
+    powm_mod._MESH = mesh
+    try:
+        bases, exps, mods = _random_rows(768, (768, 256), rows=8)
+        got = multi_powm(bases, exps, mods, device=True)
+    finally:
+        powm_mod._MESH = monkey
+    for r in range(len(mods)):
+        assert got[r] == _oracle_row(bases[r], exps[r], mods[r])
+
+
+def test_powm_columns_mixed_scalar_and_multi():
+    m1, m2 = _odd_mod(768), _odd_mod(768)
+    scalar_col = (
+        [RNG.randrange(1, m1) for _ in range(3)],
+        [RNG.getrandbits(256) for _ in range(3)],
+        [m1] * 3,
+    )
+    mb, me, mm = _random_rows(768, (512, 256), rows=3, shared_mod=False)
+    multi_col = (mb, me, mm)
+    out = powm_columns(host_powm, scalar_col, multi_col, multi_col)
+    assert out[0] == [
+        pow(b, e, m) for b, e, m in zip(*scalar_col)
+    ]
+    for r in range(3):
+        assert out[1][r] == _oracle_row(mb[r], me[r], mm[r])
+    assert out[2] == out[1]  # dedup path
+    assert out[2] is not out[1]  # no aliasing across columns
+    assert m2  # keep the second modulus sampled (determinism of RNG use)
+
+
+def test_batch_base_inv():
+    m = _odd_mod(768)
+    vals = [RNG.randrange(2, m) for _ in range(6)]
+    out = batch_base_inv(vals, [m] * 6)
+    for v, inv in zip(vals, out):
+        if inv is not None:
+            assert v * inv % m == 1
+    # a non-invertible row reports None without poisoning its neighbors
+    import math
+
+    p = 0xFFFF_FFFB  # prime factor of the modulus
+    m2 = p * _odd_mod(64)
+    vals2 = [p, RNG.randrange(2, m2) | 1]
+    while math.gcd(vals2[1], m2) != 1:
+        vals2[1] = RNG.randrange(2, m2) | 1
+    out2 = batch_base_inv(vals2, [m2] * 2)
+    assert out2[0] is None
+    assert out2[1] is not None and vals2[1] * out2[1] % m2 == 1
+
+
+# ---------------------------------------------------------------------------
+# collect-level A/B identity: joint and column planners must produce
+# bit-identical accept/reject behavior on the tamper surface they share
+
+
+def _collect(refreshed, config, mutate, collector=0):
+    keys, msgs, dks = refreshed
+    msgs = copy.deepcopy(msgs)
+    mutate(msgs)
+    key = keys[collector].clone()
+    from fsdkr_tpu.protocol import RefreshMessage
+
+    RefreshMessage.collect(msgs, key, dks[collector], (), config)
+
+
+_AB_CASES = [
+    ("honest", lambda msgs: None),
+    (
+        "pdl_s1",
+        lambda msgs: msgs[1].pdl_proof_vec.__setitem__(
+            0,
+            dataclasses.replace(
+                msgs[1].pdl_proof_vec[0], s1=msgs[1].pdl_proof_vec[0].s1 + 1
+            ),
+        ),
+    ),
+    (
+        "pdl_s2",
+        lambda msgs: msgs[1].pdl_proof_vec.__setitem__(
+            0,
+            dataclasses.replace(
+                msgs[1].pdl_proof_vec[0], s2=msgs[1].pdl_proof_vec[0].s2 + 1
+            ),
+        ),
+    ),
+    (
+        "pdl_u2",
+        lambda msgs: msgs[1].pdl_proof_vec.__setitem__(
+            0,
+            dataclasses.replace(
+                msgs[1].pdl_proof_vec[0], u2=msgs[1].pdl_proof_vec[0].u2 + 1
+            ),
+        ),
+    ),
+    (
+        "range_s",
+        lambda msgs: msgs[1].range_proofs.__setitem__(
+            0,
+            dataclasses.replace(
+                msgs[1].range_proofs[0], s=msgs[1].range_proofs[0].s + 1
+            ),
+        ),
+    ),
+    (
+        "range_z",
+        lambda msgs: msgs[1].range_proofs.__setitem__(
+            0,
+            dataclasses.replace(
+                msgs[1].range_proofs[0], z=msgs[1].range_proofs[0].z + 1
+            ),
+        ),
+    ),
+    (
+        "range_e",
+        lambda msgs: msgs[1].range_proofs.__setitem__(
+            0,
+            dataclasses.replace(
+                msgs[1].range_proofs[0], e=msgs[1].range_proofs[0].e ^ 1
+            ),
+        ),
+    ),
+]
+
+
+@pytest.mark.heavy
+@pytest.mark.parametrize("name,mutate", _AB_CASES, ids=[c[0] for c in _AB_CASES])
+def test_collect_joint_vs_column_identity(
+    name, mutate, one_refresh_round, test_config, monkeypatch
+):
+    """The FSDKR_MULTIEXP=1 (joint rows) and =0 (column) planners must
+    accept/reject identically, with the same error class, on the exact
+    equations the joint rewrite touched (PDL u2, range u/w)."""
+    config = test_config.with_backend("tpu")
+    outcomes = {}
+    for flag in ("1", "0"):
+        monkeypatch.setenv("FSDKR_MULTIEXP", flag)
+        try:
+            _collect(one_refresh_round, config, mutate)
+            outcomes[flag] = None
+        except Exception as e:  # noqa: BLE001 - compare classes exactly
+            outcomes[flag] = type(e).__name__
+    assert outcomes["1"] == outcomes["0"], outcomes
+    if name == "honest":
+        assert outcomes["1"] is None

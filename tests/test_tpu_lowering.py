@@ -151,6 +151,47 @@ class TestKernelsLowerForTpu:
         for fn, args, kwargs in calls:
             lower_for_tpu(fn, args, kwargs)
 
+    def test_cios_multi_exp(self):
+        """Joint (Straus) multi-exponentiation kernel: the FSDKR_MULTIEXP
+        pair-loop rows [s, c^{-1}] with exponents [n, e]."""
+        moduli = [
+            secrets.randbits(BITS) | (1 << (BITS - 1)) | 1 for _ in range(8)
+        ]
+        bases = [
+            (secrets.randbelow(n - 1) + 1, secrets.randbelow(n - 1) + 1)
+            for n in moduli
+        ]
+        exps = [
+            (secrets.randbits(BITS), secrets.randbits(64)) for _ in moduli
+        ]
+        calls = []
+        with capture_calls(montgomery, "_multi_modexp_kernel", calls):
+            montgomery.multi_modexp(
+                bases, exps, moduli, limbs_for_bits(BITS), (BITS, 64)
+            )
+        assert calls, "driver never reached the multi-exp kernel"
+        for fn, args, kwargs in calls:
+            lower_for_tpu(fn, args, kwargs)
+
+    def test_rns_multi_exp(self, monkeypatch):
+        monkeypatch.setenv("FSDKR_PALLAS", "0")
+        moduli = [
+            secrets.randbits(BITS) | (1 << (BITS - 1)) | 1 for _ in range(8)
+        ]
+        bases = [
+            (secrets.randbelow(n - 1) + 1, secrets.randbelow(n - 1) + 1)
+            for n in moduli
+        ]
+        exps = [
+            (secrets.randbits(BITS), secrets.randbits(64)) for _ in moduli
+        ]
+        calls = []
+        with capture_calls(rns, "_rns_multi_modexp_kernel", calls):
+            rns.rns_multi_modexp(bases, exps, moduli, BITS, (BITS, 64))
+        assert calls, "driver never reached the RNS multi-exp kernel"
+        for fn, args, kwargs in calls:
+            lower_for_tpu(fn, args, kwargs)
+
     def test_ec_batch(self):
         from fsdkr_tpu.core import secp256k1 as ec
 
