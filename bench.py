@@ -459,11 +459,19 @@ def main():
         f"{cache_after - cache_before} fresh compiles)"
     )
 
+    from fsdkr_tpu.backend.powm import powm_cache_stats
+
+    cache_cold = powm_cache_stats()
     get_tracer().reset()
     t0 = time.time()
     RefreshMessage.collect(msgs, keys[1].clone(), dks[1], (), tpu_cfg)
     t_tpu = time.time() - t0
-    log(f"tpu collect warm: {t_tpu:.2f}s -> {proofs / t_tpu:.1f} proofs/s")
+    cache_warm = powm_cache_stats()
+    log(
+        f"tpu collect warm: {t_tpu:.2f}s -> {proofs / t_tpu:.1f} proofs/s "
+        f"(precompute cache: +{cache_warm['hits'] - cache_cold['hits']} hits, "
+        f"+{cache_warm['misses'] - cache_cold['misses']} misses warm)"
+    )
     trace_out = None
     rf = {}
     if get_tracer().enabled:  # FSDKR_TRACE=1: per-family breakdown
@@ -577,6 +585,15 @@ def main():
         "compile_overhead_s": round(t_tpu_cold - t_tpu, 2),
         "fresh_compiles": cache_after - cache_before,
         "distribute_batch_s": round(t_distribute, 2),
+        # persistent precompute cache (comb tables / power ladders /
+        # Montgomery contexts): warm-collect deltas — misses_warm == 0
+        # means every table build was served from the cache
+        "powm_cache": {
+            **cache_warm,
+            "hits_warm": cache_warm["hits"] - cache_cold["hits"],
+            "misses_warm": cache_warm["misses"] - cache_cold["misses"],
+        },
+        "fsdkr_threads": native.thread_count(),
     }
     if trace_out:
         result["trace"] = trace_out  # warm-collect per-phase seconds

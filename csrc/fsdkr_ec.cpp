@@ -16,14 +16,52 @@
 // elements are 4 little-endian u64 limbs; affine points are (x, y)
 // limb pairs; (0, 0) encodes the identity (it is not on the curve).
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 using u32 = uint32_t;
 using u64 = uint64_t;
 using u128 = __uint128_t;
 
 namespace {
+
+// Row parallelism (same contract as csrc/fsdkr_native.cpp): batch rows
+// are independent point equations writing disjoint output slots, so a
+// chunked row split is bit-identical to the serial loop at any thread
+// count. The shared-inversion batch_to_affine pass stays serial — it is
+// one field inversion plus ~5 muls per row, noise next to the per-row
+// scalar ladders. Deliberately DUPLICATED from fsdkr_native.cpp rather
+// than shared via a header: the loader builds and hash-tags exactly one
+// source file per core (native/_loader.py), so an #include'd header
+// would not participate in the .so cache tag and edits to it would load
+// stale artifacts. Keep the two copies in lock-step.
+std::atomic<int> g_threads{1};
+
+template <class F>
+void parallel_rows(int rows, const F &fn) {
+  int nt = g_threads.load(std::memory_order_relaxed);
+  if (nt > rows) nt = rows;
+  if (nt <= 1 || rows <= 1) {
+    fn(0, rows);
+    return;
+  }
+  std::vector<std::thread> ts;
+  ts.reserve(nt - 1);
+  const int chunk = rows / nt, rem = rows % nt;
+  int lo = 0;
+  for (int i = 0; i < nt; i++) {
+    const int hi = lo + chunk + (i < rem ? 1 : 0);
+    if (i == nt - 1)
+      fn(lo, hi);
+    else
+      ts.emplace_back([&fn, lo, hi] { fn(lo, hi); });
+    lo = hi;
+  }
+  for (auto &t : ts) t.join();
+}
 
 // p = 2^256 - 0x1000003D1
 const u64 PRIME[4] = {0xFFFFFFFEFFFFFC2FULL, 0xFFFFFFFFFFFFFFFFULL,
@@ -382,6 +420,16 @@ inline bool load_affine_jac(jac &r, const u64 *p) {
 
 extern "C" {
 
+// Thread-count control (FSDKR_THREADS bridge). Returns the applied count.
+int fsdkr_ec_set_threads(int n) {
+  if (n <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    n = hc ? (int)hc : 1;
+  }
+  g_threads.store(n, std::memory_order_relaxed);
+  return n;
+}
+
 // out[j] = sum_k A_k * idx[j]^k, Horner over the shared commitment
 // vector (t1 affine points, A_0 first). The Feldman check's exact
 // evaluation order (core/vss.py validate_share_public).
@@ -389,24 +437,26 @@ int fsdkr_ec_horner_batch(const u64 *commits, int t1, const u32 *idxs,
                           int m, u64 *out) {
   if (t1 <= 0 || m <= 0) return 1;
   jac *res = new jac[m];
-  for (int j = 0; j < m; ++j) {
-    jac acc;
-    load_affine_jac(acc, commits + (size_t)(t1 - 1) * 8);
-    for (int k = t1 - 2; k >= 0; --k) {
-      jac t;
-      jac_mul_small(t, acc, idxs[j]);
-      const u64 *ak = commits + (size_t)k * 8;
-      fe x, y;
-      load_fe(x, ak);
-      load_fe(y, ak + 4);
-      if (fe_is_zero(x) && fe_is_zero(y)) {
-        acc = t;  // identity commitment: acc*idx + 0
-      } else {
-        jac_madd(acc, t, x, y);
+  parallel_rows(m, [&](int lo, int hi) {
+    for (int j = lo; j < hi; ++j) {
+      jac acc;
+      load_affine_jac(acc, commits + (size_t)(t1 - 1) * 8);
+      for (int k = t1 - 2; k >= 0; --k) {
+        jac t;
+        jac_mul_small(t, acc, idxs[j]);
+        const u64 *ak = commits + (size_t)k * 8;
+        fe x, y;
+        load_fe(x, ak);
+        load_fe(y, ak + 4);
+        if (fe_is_zero(x) && fe_is_zero(y)) {
+          acc = t;  // identity commitment: acc*idx + 0
+        } else {
+          jac_madd(acc, t, x, y);
+        }
       }
+      res[j] = acc;
     }
-    res[j] = acc;
-  }
+  });
   batch_to_affine(res, m, out);
   delete[] res;
   return 0;
@@ -418,16 +468,18 @@ int fsdkr_ec_scalar_mul_batch(const u64 *points, const u64 *scalars, int n,
                               u64 *out) {
   if (n <= 0) return 1;
   jac *res = new jac[n];
-  for (int i = 0; i < n; ++i) {
-    fe x, y;
-    load_fe(x, points + (size_t)i * 8);
-    load_fe(y, points + (size_t)i * 8 + 4);
-    if (fe_is_zero(x) && fe_is_zero(y)) {
-      jac_set_inf(res[i]);
-    } else {
-      jac_mul(res[i], x, y, scalars + (size_t)i * 4);
+  parallel_rows(n, [&](int lo, int hi) {
+    for (int i = lo; i < hi; ++i) {
+      fe x, y;
+      load_fe(x, points + (size_t)i * 8);
+      load_fe(y, points + (size_t)i * 8 + 4);
+      if (fe_is_zero(x) && fe_is_zero(y)) {
+        jac_set_inf(res[i]);
+      } else {
+        jac_mul(res[i], x, y, scalars + (size_t)i * 4);
+      }
     }
-  }
+  });
   batch_to_affine(res, n, out);
   delete[] res;
   return 0;
@@ -438,23 +490,25 @@ int fsdkr_ec_lincomb2_batch(const u64 *P, const u64 *a, const u64 *Q,
                             const u64 *b, int n, u64 *out) {
   if (n <= 0) return 1;
   jac *res = new jac[n];
-  for (int i = 0; i < n; ++i) {
-    jac pa, qb;
-    fe x, y;
-    load_fe(x, P + (size_t)i * 8);
-    load_fe(y, P + (size_t)i * 8 + 4);
-    if (fe_is_zero(x) && fe_is_zero(y))
-      jac_set_inf(pa);
-    else
-      jac_mul(pa, x, y, a + (size_t)i * 4);
-    load_fe(x, Q + (size_t)i * 8);
-    load_fe(y, Q + (size_t)i * 8 + 4);
-    if (fe_is_zero(x) && fe_is_zero(y))
-      jac_set_inf(qb);
-    else
-      jac_mul(qb, x, y, b + (size_t)i * 4);
-    jac_add(res[i], pa, qb);
-  }
+  parallel_rows(n, [&](int lo, int hi) {
+    for (int i = lo; i < hi; ++i) {
+      jac pa, qb;
+      fe x, y;
+      load_fe(x, P + (size_t)i * 8);
+      load_fe(y, P + (size_t)i * 8 + 4);
+      if (fe_is_zero(x) && fe_is_zero(y))
+        jac_set_inf(pa);
+      else
+        jac_mul(pa, x, y, a + (size_t)i * 4);
+      load_fe(x, Q + (size_t)i * 8);
+      load_fe(y, Q + (size_t)i * 8 + 4);
+      if (fe_is_zero(x) && fe_is_zero(y))
+        jac_set_inf(qb);
+      else
+        jac_mul(qb, x, y, b + (size_t)i * 4);
+      jac_add(res[i], pa, qb);
+    }
+  });
   batch_to_affine(res, n, out);
   delete[] res;
   return 0;

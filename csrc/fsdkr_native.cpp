@@ -15,16 +15,71 @@
 // width; moduli must be odd. Maximum width 64 limbs = 4096 bits (the
 // protocol's widest modulus class, N^2 for 2048-bit Paillier N).
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <new>
+#include <thread>
+#include <vector>
 
 typedef uint64_t u64;
 typedef unsigned __int128 u128;
 
 static const int MAXL = 64; // 4096 bits
 
+// ---------------------------------------------------------------------------
+// Row parallelism. Every batch entry point below iterates over rows that
+// are mathematically independent (per-row modulus, per-row output slice),
+// so splitting the row range across threads is bit-identical to the
+// serial loop at any thread count — the per-row computation is exactly
+// the same code, and no row reads another row's state. The count is set
+// from Python (FSDKR_THREADS; 0 = auto from hardware_concurrency, 1 =
+// serial). Threads are spawned per call: batch calls are
+// milliseconds-to-seconds of work, so spawn cost (~tens of us) is noise,
+// and no pool lifecycle can leak across fork or library reload.
+
+static std::atomic<int> g_threads{1};
+
+template <class F>
+static void parallel_rows(int rows, const F &fn) {
+  int nt = g_threads.load(std::memory_order_relaxed);
+  if (nt > rows)
+    nt = rows;
+  if (nt <= 1 || rows <= 1) {
+    fn(0, rows);
+    return;
+  }
+  std::vector<std::thread> ts;
+  ts.reserve(nt - 1);
+  const int chunk = rows / nt, rem = rows % nt;
+  int lo = 0;
+  for (int i = 0; i < nt; i++) {
+    const int hi = lo + chunk + (i < rem ? 1 : 0);
+    if (i == nt - 1)
+      fn(lo, hi); // run the last chunk on the calling thread
+    else
+      ts.emplace_back([&fn, lo, hi] { fn(lo, hi); });
+    lo = hi;
+  }
+  for (auto &t : ts)
+    t.join();
+}
+
 extern "C" {
+
+// Thread-count control (FSDKR_THREADS bridge). Returns the applied count.
+int fsdkr_set_threads(int n) {
+  if (n <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    n = hc ? (int)hc : 1;
+  }
+  g_threads.store(n, std::memory_order_relaxed);
+  return n;
+}
+
+int fsdkr_get_threads(void) {
+  return g_threads.load(std::memory_order_relaxed);
+}
 
 // ---------------------------------------------------------------------------
 // limb helpers
@@ -341,80 +396,90 @@ int fsdkr_miller_rabin(const u64 *n, int L, const u64 *witnesses, int rounds) {
   u64 n1_m[MAXL]; // n-1 in Montgomery form, for comparisons
   mont_mul(n1_m, n1, r2, n, n0inv, L);
 
-  u64 a_m[MAXL];
-  u64 ared[MAXL];
-  u64 x[MAXL];
-  for (int round = 0; round < rounds; round++) {
-    const u64 *a = witnesses + (size_t)round * L;
-    std::memcpy(ared, a, sizeof(u64) * L);
-    while (cmp_limbs(ared, n, L) >= 0)
-      sub_limbs(ared, ared, n, L);
-    mont_mul(a_m, ared, r2, n, n0inv, L);
-
-    // x = a^d mod n (Montgomery domain, square-and-multiply MSB-first)
-    int top_bit = -1;
-    for (int i = L - 1; i >= 0 && top_bit < 0; i--)
-      if (d[i])
-        for (int bit = 63; bit >= 0; bit--)
-          if ((d[i] >> bit) & 1) {
-            top_bit = i * 64 + bit;
-            break;
-          }
-    std::memcpy(x, one_m, sizeof(u64) * L);
-    for (int bit = top_bit; bit >= 0; bit--) {
-      mont_sqr(x, x, n, n0inv, L);
-      if ((d[bit / 64] >> (bit % 64)) & 1)
-        mont_mul(x, x, a_m, n, n0inv, L);
-    }
-
-    if (cmp_limbs(x, one_m, L) == 0 || cmp_limbs(x, n1_m, L) == 0)
-      continue;
-    bool witness = true;
-    for (int i = 0; i < r - 1; i++) {
-      mont_sqr(x, x, n, n0inv, L);
-      if (cmp_limbs(x, n1_m, L) == 0) {
-        witness = false;
+  // Rounds are independent (each witness runs its own power chain from
+  // shared read-only constants), so they split across threads; the
+  // verdict is "composite iff ANY round found a witness", which is
+  // order-independent — identical at every thread count. A found
+  // witness short-circuits the remaining rounds on every thread.
+  std::atomic<bool> composite{false};
+  parallel_rows(rounds, [&](int lo, int hi) {
+    u64 a_m[MAXL];
+    u64 ared[MAXL];
+    u64 x[MAXL];
+    for (int round = lo; round < hi; round++) {
+      if (composite.load(std::memory_order_relaxed))
         break;
+      const u64 *a = witnesses + (size_t)round * L;
+      std::memcpy(ared, a, sizeof(u64) * L);
+      while (cmp_limbs(ared, n, L) >= 0)
+        sub_limbs(ared, ared, n, L);
+      mont_mul(a_m, ared, r2, n, n0inv, L);
+
+      // x = a^d mod n (Montgomery domain, square-and-multiply MSB-first)
+      int top_bit = -1;
+      for (int i = L - 1; i >= 0 && top_bit < 0; i--)
+        if (d[i])
+          for (int bit = 63; bit >= 0; bit--)
+            if ((d[i] >> bit) & 1) {
+              top_bit = i * 64 + bit;
+              break;
+            }
+      std::memcpy(x, one_m, sizeof(u64) * L);
+      for (int bit = top_bit; bit >= 0; bit--) {
+        mont_sqr(x, x, n, n0inv, L);
+        if ((d[bit / 64] >> (bit % 64)) & 1)
+          mont_mul(x, x, a_m, n, n0inv, L);
       }
+
+      if (cmp_limbs(x, one_m, L) == 0 || cmp_limbs(x, n1_m, L) == 0)
+        continue;
+      bool witness = true;
+      for (int i = 0; i < r - 1; i++) {
+        mont_sqr(x, x, n, n0inv, L);
+        if (cmp_limbs(x, n1_m, L) == 0) {
+          witness = false;
+          break;
+        }
+      }
+      if (witness)
+        composite.store(true, std::memory_order_relaxed);
     }
-    if (witness) {
-      secure_wipe(d, L);
-      secure_wipe(n1, L);
-      secure_wipe(n1_m, L);
-      secure_wipe(x, L);
-      secure_wipe(a_m, L);
-      secure_wipe(ared, L);
-      // one_m/r2 are R mod n and R^2 mod n with R public: n is
-      // recoverable from either (gcd(R - one_m, R^2 - r2)), so they are
-      // as secret as the prime candidate itself
-      secure_wipe(one_m, L);
-      secure_wipe(r2, L);
-      return 0; // composite
-    }
-  }
+    // witness-power state derives from the secret prime candidate
+    secure_wipe(x, MAXL);
+    secure_wipe(a_m, MAXL);
+    secure_wipe(ared, MAXL);
+  });
   secure_wipe(d, L);
   secure_wipe(n1, L);
   secure_wipe(n1_m, L);
-  secure_wipe(x, L);
-  secure_wipe(a_m, L);
-  secure_wipe(ared, L);
+  // one_m/r2 are R mod n and R^2 mod n with R public: n is recoverable
+  // from either (gcd(R - one_m, R^2 - r2)), so they are as secret as
+  // the prime candidate itself
   secure_wipe(one_m, L);
   secure_wipe(r2, L);
-  return 1; // probable prime
+  return composite.load() ? 0 : 1;
 }
 
 // Batched modexp over a column of rows (independent moduli): the host
 // backend's powm shape. Returns 0 on success, -1 on any bad row input.
 int fsdkr_modexp_batch_w(const u64 *bases, const u64 *exps, const u64 *mods,
                          u64 *outs, int rows, int L, int EL, int wbits) {
-  for (int i = 0; i < rows; i++) {
-    int rc = fsdkr_modexp_w(bases + (size_t)i * L, exps + (size_t)i * EL,
-                            mods + (size_t)i * L, outs + (size_t)i * L, L,
-                            EL, wbits);
-    if (rc != 0)
-      return rc;
-  }
-  return 0;
+  // Rows are independent; a bad row on any thread fails the whole batch
+  // (the Python bridge discards every output and falls back row-wise, so
+  // which rows were written before the failure is unobservable).
+  std::atomic<int> rc{0};
+  parallel_rows(rows, [&](int lo, int hi) {
+    for (int i = lo; i < hi; i++) {
+      if (rc.load(std::memory_order_relaxed) != 0)
+        return;
+      int r = fsdkr_modexp_w(bases + (size_t)i * L, exps + (size_t)i * EL,
+                             mods + (size_t)i * L, outs + (size_t)i * L, L,
+                             EL, wbits);
+      if (r != 0)
+        rc.store(r, std::memory_order_relaxed);
+    }
+  });
+  return rc.load();
 }
 
 int fsdkr_modexp_batch(const u64 *bases, const u64 *exps, const u64 *mods,
@@ -433,18 +498,43 @@ int fsdkr_modexp_batch(const u64 *bases, const u64 *exps, const u64 *mods,
 // grow the per-group table build by 2^wbits, so the bridge picks wbits
 // by rows-per-group (w=6 beats w=4 by ~22% at the ring-Pedersen M=256
 // shape; w=4 stays optimal for the n-row pair groups).
-int fsdkr_modexp_shared_w(const u64 *base, const u64 *exps, const u64 *n,
-                          u64 *outs, int M, int L, int EL, int wbits) {
-  // EL is capped: verify-side exponents are adversary-supplied proof
-  // integers, and the comb table is (64 EL / wbits)*2^wbits*L words — an
-  // unbounded EL would let one malicious proof force a huge (or
-  // throwing) allocation where the generic kernel merely computes
-  // slowly. 2*MAXL limbs = 8192 bits covers every protocol exponent
-  // incl. range slack.
-  if (L <= 0 || L > MAXL || EL <= 0 || EL > 2 * MAXL || M <= 0 ||
-      wbits < 1 || wbits > 8 || !(n[0] & 1))
+// Comb geometry validation shared by precompute/apply/one-shot.
+// EL is capped: verify-side exponents are adversary-supplied proof
+// integers, and the comb table is (64 EL / wbits)*2^wbits*L words — an
+// unbounded EL would let one malicious proof force a huge (or throwing)
+// allocation where the generic kernel merely computes slowly. 2*MAXL
+// limbs = 8192 bits covers every protocol exponent incl. range slack.
+static int comb_windows(int L, int EL, int wbits, const u64 *n) {
+  if (L <= 0 || L > MAXL || EL <= 0 || EL > 2 * MAXL || wbits < 1 ||
+      wbits > 8 || !(n[0] & 1))
     return -1;
+  return (EL * 64 + wbits - 1) / wbits;
+}
 
+// Words needed for a comb window table of this geometry (Python sizes
+// the cacheable buffer with this; -1 on bad geometry). Fits int: the
+// EL/wbits caps bound the table at (8192/8)*2^8*64 < 2^25 words.
+int fsdkr_comb_table_words(int L, int EL, int wbits) {
+  u64 odd = 1;
+  int W = comb_windows(L, EL, wbits, &odd);
+  if (W < 0)
+    return -1;
+  return W * (1 << wbits) * L;
+}
+
+// Build the comb window table for one (base, modulus) into a
+// caller-owned buffer of fsdkr_comb_table_words words: per window w the
+// 2^wbits entries (base^((2^wbits)^w))^d in Montgomery form. The table
+// derives ONLY from (base, modulus, geometry) — no exponent ever enters
+// it — so callers may cache it across calls for PUBLIC bases/moduli
+// (ring-Pedersen h1/h2/T); secret-base callers must stay on the
+// one-shot fsdkr_modexp_shared_w, which wipes the table before free.
+int fsdkr_comb_precompute(const u64 *base, const u64 *n, u64 *table, int L,
+                          int EL, int wbits) {
+  const int W = comb_windows(L, EL, wbits, n);
+  if (W < 0)
+    return -1;
+  const int D = 1 << wbits;
   const u64 n0inv = mont_n0inv(n[0]);
   u64 one_m[MAXL], r2[MAXL];
   mont_constants(n, L, one_m, r2);
@@ -454,13 +544,7 @@ int fsdkr_modexp_shared_w(const u64 *base, const u64 *exps, const u64 *n,
   while (cmp_limbs(b, n, L) >= 0)
     sub_limbs(b, b, n, L);
 
-  const int D = 1 << wbits;             // table entries per window
-  const int W = (EL * 64 + wbits - 1) / wbits;  // windows over the limbs
-  u64 *table = new (std::nothrow) u64[(size_t)W * D * L];
-  if (!table)
-    return -1;
   auto T = [&](int w, int d) { return table + ((size_t)w * D + d) * L; };
-
   u64 pw[MAXL];  // base^((2^wbits)^w) in Montgomery form
   mont_mul(pw, b, r2, n, n0inv, L);
   for (int w = 0; w < W; w++) {
@@ -475,41 +559,70 @@ int fsdkr_modexp_shared_w(const u64 *base, const u64 *exps, const u64 *n,
     if (w + 1 < W)  // pw <- pw^(2^wbits) = (pw^(2^(wbits-1)))^2
       mont_sqr(pw, T(w, D / 2), n, n0inv, L);
   }
-
-  u64 onev[MAXL];
-  std::memset(onev, 0, sizeof(u64) * L);
-  onev[0] = 1;
-  u64 acc[MAXL];
-  const u64 mask = (u64)D - 1;
-  for (int m = 0; m < M; m++) {
-    const u64 *e = exps + (size_t)m * EL;
-    std::memcpy(acc, one_m, sizeof(u64) * L);
-    // one multiply per window unconditionally (d == 0 hits the one_m
-    // entry): prover-side exponents are secret key shares and nonces,
-    // and a zero-digit skip would make wall time a function of their
-    // contents — the generic kernel is uniform per window for the same
-    // reason
-    for (int w = 0; w < W; w++) {
-      int bit0 = w * wbits;  // windows may straddle a 64-bit limb
-      u64 d = e[bit0 / 64] >> (bit0 % 64);
-      if (bit0 % 64 + wbits > 64 && bit0 / 64 + 1 < EL)
-        d |= e[bit0 / 64 + 1] << (64 - bit0 % 64);
-      d &= mask;
-      mont_mul(acc, acc, T(w, (int)d), n, n0inv, L);
-    }
-    mont_mul(outs + (size_t)m * L, acc, onev, n, n0inv, L);
-  }
-
-  // same wipe discipline as fsdkr_modexp: the table and constants can
-  // reconstruct base/modulus state (secret on prover-side uses)
-  secure_wipe(table, W * D * L);
-  delete[] table;
   secure_wipe(b, L);
   secure_wipe(pw, L);
-  secure_wipe(acc, L);
   secure_wipe(one_m, L);
   secure_wipe(r2, L);
   return 0;
+}
+
+// Run M rows against a prebuilt comb table (fsdkr_comb_precompute with
+// the same geometry). Rows are independent and split across threads.
+int fsdkr_comb_apply(const u64 *table, const u64 *exps, const u64 *n,
+                     u64 *outs, int M, int L, int EL, int wbits) {
+  const int W = comb_windows(L, EL, wbits, n);
+  if (W < 0 || M <= 0)
+    return -1;
+  const int D = 1 << wbits;
+  const u64 n0inv = mont_n0inv(n[0]);
+  const u64 *one_m = table;  // T(0, 0) is the Montgomery one
+  auto T = [&](int w, int d) { return table + ((size_t)w * D + d) * L; };
+  const u64 mask = (u64)D - 1;
+  parallel_rows(M, [&](int lo, int hi) {
+    u64 acc[MAXL];
+    u64 onev[MAXL];
+    std::memset(onev, 0, sizeof(u64) * MAXL);
+    onev[0] = 1;
+    for (int m = lo; m < hi; m++) {
+      const u64 *e = exps + (size_t)m * EL;
+      std::memcpy(acc, one_m, sizeof(u64) * L);
+      // one multiply per window unconditionally (d == 0 hits the one_m
+      // entry): prover-side exponents are secret key shares and nonces,
+      // and a zero-digit skip would make wall time a function of their
+      // contents — the generic kernel is uniform per window for the
+      // same reason
+      for (int w = 0; w < W; w++) {
+        int bit0 = w * wbits;  // windows may straddle a 64-bit limb
+        u64 d = e[bit0 / 64] >> (bit0 % 64);
+        if (bit0 % 64 + wbits > 64 && bit0 / 64 + 1 < EL)
+          d |= e[bit0 / 64 + 1] << (64 - bit0 % 64);
+        d &= mask;
+        mont_mul(acc, acc, T(w, (int)d), n, n0inv, L);
+      }
+      mont_mul(outs + (size_t)m * L, acc, onev, n, n0inv, L);
+    }
+    secure_wipe(acc, MAXL);  // exponent-derived accumulator state
+  });
+  return 0;
+}
+
+int fsdkr_modexp_shared_w(const u64 *base, const u64 *exps, const u64 *n,
+                          u64 *outs, int M, int L, int EL, int wbits) {
+  const int W = comb_windows(L, EL, wbits, n);
+  if (W < 0 || M <= 0)
+    return -1;
+  const int D = 1 << wbits;
+  u64 *table = new (std::nothrow) u64[(size_t)W * D * L];
+  if (!table)
+    return -1;
+  int rc = fsdkr_comb_precompute(base, n, table, L, EL, wbits);
+  if (rc == 0)
+    rc = fsdkr_comb_apply(table, exps, n, outs, M, L, EL, wbits);
+  // same wipe discipline as fsdkr_modexp: the table can reconstruct
+  // base/modulus state (secret on prover-side uses of this one-shot)
+  secure_wipe(table, W * D * L);
+  delete[] table;
+  return rc;
 }
 
 // ABI-stable 4-bit-window entry point (older bridges / capture tooling)
@@ -560,63 +673,170 @@ int fsdkr_multi_modexp_batch(const u64 *bases, const u64 *exps,
     if (!(mods[(size_t)r * L] & 1))
       return -1;
 
-  u64 *table = new (std::nothrow) u64[(size_t)k * D * L];
-  if (!table)
-    return -1;
-  auto T = [&](int t, int d) { return table + ((size_t)t * D + d) * L; };
-
-  u64 one_m[MAXL], r2[MAXL], b[MAXL], base_m[MAXL], acc[MAXL], onev[MAXL];
-  std::memset(onev, 0, sizeof(u64) * MAXL);
-  onev[0] = 1;
-  for (int r = 0; r < rows; r++) {
-    const u64 *n = mods + (size_t)r * L;
-    const u64 n0inv = mont_n0inv(n[0]);
-    mont_constants(n, L, one_m, r2);
-
-    for (int t = 0; t < k; t++) {
-      std::memcpy(b, bases + ((size_t)r * k + t) * L, sizeof(u64) * L);
-      while (cmp_limbs(b, n, L) >= 0)
-        sub_limbs(b, b, n, L);
-      mont_mul(base_m, b, r2, n, n0inv, L);
-      std::memcpy(T(t, 0), one_m, sizeof(u64) * L);
-      std::memcpy(T(t, 1), base_m, sizeof(u64) * L);
-      for (int d = 2; d < D; d++) {
-        if (d % 2 == 0)
-          mont_sqr(T(t, d), T(t, d / 2), n, n0inv, L);
-        else
-          mont_mul(T(t, d), T(t, d - 1), base_m, n, n0inv, L);
-      }
+  // Rows split across threads; each thread owns a private per-term table
+  // allocation and temporaries, so the per-row work is byte-identical to
+  // the serial loop. A failed allocation on any thread fails the batch.
+  std::atomic<int> rc{0};
+  parallel_rows(rows, [&](int lo, int hi) {
+    u64 *table = new (std::nothrow) u64[(size_t)k * D * L];
+    if (!table) {
+      rc.store(-1, std::memory_order_relaxed);
+      return;
     }
+    auto T = [&](int t, int d) { return table + ((size_t)t * D + d) * L; };
 
-    const u64 mask = (u64)D - 1;
-    std::memcpy(acc, one_m, sizeof(u64) * L);
-    for (int w = W - 1; w >= 0; w--) {
-      if (w != W - 1) // acc is still one at the top window
-        for (int s = 0; s < wbits; s++)
-          mont_sqr(acc, acc, n, n0inv, L);
+    u64 one_m[MAXL], r2[MAXL], b[MAXL], base_m[MAXL], acc[MAXL], onev[MAXL];
+    std::memset(onev, 0, sizeof(u64) * MAXL);
+    onev[0] = 1;
+    for (int r = lo; r < hi; r++) {
+      if (rc.load(std::memory_order_relaxed) != 0)
+        break;
+      const u64 *n = mods + (size_t)r * L;
+      const u64 n0inv = mont_n0inv(n[0]);
+      mont_constants(n, L, one_m, r2);
+
       for (int t = 0; t < k; t++) {
-        if (w >= Wt[t])
-          continue; // static per-launch schedule (ebits), not data
-        const u64 *e = exps + ((size_t)r * k + t) * EL;
-        int bit0 = w * wbits; // windows may straddle a 64-bit limb
-        u64 d = e[bit0 / 64] >> (bit0 % 64);
-        if (bit0 % 64 + wbits > 64 && bit0 / 64 + 1 < EL)
-          d |= e[bit0 / 64 + 1] << (64 - bit0 % 64);
-        d &= mask;
-        mont_mul(acc, acc, T(t, (int)d), n, n0inv, L);
+        std::memcpy(b, bases + ((size_t)r * k + t) * L, sizeof(u64) * L);
+        while (cmp_limbs(b, n, L) >= 0)
+          sub_limbs(b, b, n, L);
+        mont_mul(base_m, b, r2, n, n0inv, L);
+        std::memcpy(T(t, 0), one_m, sizeof(u64) * L);
+        std::memcpy(T(t, 1), base_m, sizeof(u64) * L);
+        for (int d = 2; d < D; d++) {
+          if (d % 2 == 0)
+            mont_sqr(T(t, d), T(t, d / 2), n, n0inv, L);
+          else
+            mont_mul(T(t, d), T(t, d - 1), base_m, n, n0inv, L);
+        }
       }
-    }
-    mont_mul(outs + (size_t)r * L, acc, onev, n, n0inv, L);
-  }
 
-  secure_wipe(table, k * D * L);
-  delete[] table;
-  secure_wipe(b, MAXL);
-  secure_wipe(base_m, MAXL);
-  secure_wipe(acc, MAXL);
-  secure_wipe(one_m, MAXL); // one_m/r2 reconstruct the modulus
-  secure_wipe(r2, MAXL);
+      const u64 mask = (u64)D - 1;
+      std::memcpy(acc, one_m, sizeof(u64) * L);
+      for (int w = W - 1; w >= 0; w--) {
+        if (w != W - 1) // acc is still one at the top window
+          for (int s = 0; s < wbits; s++)
+            mont_sqr(acc, acc, n, n0inv, L);
+        for (int t = 0; t < k; t++) {
+          if (w >= Wt[t])
+            continue; // static per-launch schedule (ebits), not data
+          const u64 *e = exps + ((size_t)r * k + t) * EL;
+          int bit0 = w * wbits; // windows may straddle a 64-bit limb
+          u64 d = e[bit0 / 64] >> (bit0 % 64);
+          if (bit0 % 64 + wbits > 64 && bit0 / 64 + 1 < EL)
+            d |= e[bit0 / 64 + 1] << (64 - bit0 % 64);
+          d &= mask;
+          mont_mul(acc, acc, T(t, (int)d), n, n0inv, L);
+        }
+      }
+      mont_mul(outs + (size_t)r * L, acc, onev, n, n0inv, L);
+    }
+
+    secure_wipe(table, k * D * L);
+    delete[] table;
+    secure_wipe(b, MAXL);
+    secure_wipe(base_m, MAXL);
+    secure_wipe(acc, MAXL);
+    secure_wipe(one_m, MAXL); // one_m/r2 reconstruct the modulus
+    secure_wipe(r2, MAXL);
+  });
+  return rc.load();
+}
+
+// ---------------------------------------------------------------------------
+// Batched modular multiplication: outs[r] = a[r] * b[r] mod mods[r].
+// Two Montgomery products per row (enter with a*R^2, exit against b),
+// with the expensive mont_constants computed once per RUN of equal
+// consecutive moduli — the Python bridge sorts rows by modulus, and the
+// collect() recombination columns carry at most one modulus per
+// receiver, so constants amortize over the receiver's whole row group.
+// Rows split across threads (each thread rebuilds constants at its
+// chunk's first row, so chunk boundaries cannot change any row's math).
+
+int fsdkr_modmul_batch(const u64 *a, const u64 *b, const u64 *mods,
+                       u64 *outs, int rows, int L) {
+  if (L <= 0 || L > MAXL || rows <= 0)
+    return -1;
+  for (int r = 0; r < rows; r++)
+    if (!(mods[(size_t)r * L] & 1))
+      return -1;
+  parallel_rows(rows, [&](int lo, int hi) {
+    u64 one_m[MAXL], r2[MAXL], ar[MAXL], br[MAXL], a_m[MAXL];
+    const u64 *cur_n = nullptr;
+    u64 n0inv = 0;
+    for (int r = lo; r < hi; r++) {
+      const u64 *n = mods + (size_t)r * L;
+      if (cur_n == nullptr || std::memcmp(n, cur_n, sizeof(u64) * L) != 0) {
+        n0inv = mont_n0inv(n[0]);
+        mont_constants(n, L, one_m, r2);
+        cur_n = n;
+      }
+      std::memcpy(ar, a + (size_t)r * L, sizeof(u64) * L);
+      while (cmp_limbs(ar, n, L) >= 0)
+        sub_limbs(ar, ar, n, L);
+      std::memcpy(br, b + (size_t)r * L, sizeof(u64) * L);
+      while (cmp_limbs(br, n, L) >= 0)
+        sub_limbs(br, br, n, L);
+      mont_mul(a_m, ar, r2, n, n0inv, L);  // a*R mod n
+      mont_mul(outs + (size_t)r * L, a_m, br, n, n0inv, L);  // a*b mod n
+    }
+    // operands can be secret (share recombination factors); same wipe
+    // discipline as the modexp frames
+    secure_wipe(ar, MAXL);
+    secure_wipe(br, MAXL);
+    secure_wipe(a_m, MAXL);
+    secure_wipe(one_m, MAXL);
+    secure_wipe(r2, MAXL);
+  });
   return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Batch limb pack/unpack for the device staging path (ops/limbs.py).
+// The kernels' host staging is bigint -> LE bytes -> uint16 limbs ->
+// uint32 lanes; the widen/narrow passes below replace two numpy passes
+// (astype + canonicality check) with one threaded pass each, so tile
+// staging overlaps engine execution on spare cores.
+
+// u16 -> u32 widen, threaded. count = total limbs.
+int fsdkr_limbs_widen_u16(const uint16_t *in, uint32_t *out,
+                          long long count) {
+  if (count < 0)
+    return -1;
+  const long long CHUNK = 1 << 20;
+  int chunks = (int)((count + CHUNK - 1) / CHUNK);
+  if (chunks <= 0)
+    return 0;
+  parallel_rows(chunks, [&](int lo, int hi) {
+    for (long long i = (long long)lo * CHUNK;
+         i < (long long)hi * CHUNK && i < count; i++)
+      out[i] = in[i];
+  });
+  return 0;
+}
+
+// u32 -> u16 narrow with a fused canonicality check: any limb with high
+// bits set (a pending carry — a kernel bug, never valid data) fails the
+// whole batch with -2, matching limbs_to_ints' ValueError.
+int fsdkr_limbs_narrow_u16(const uint32_t *in, uint16_t *out,
+                           long long count) {
+  if (count < 0)
+    return -1;
+  const long long CHUNK = 1 << 20;
+  int chunks = (int)((count + CHUNK - 1) / CHUNK);
+  if (chunks <= 0)
+    return 0;
+  std::atomic<int> rc{0};
+  parallel_rows(chunks, [&](int lo, int hi) {
+    uint32_t pending = 0;
+    for (long long i = (long long)lo * CHUNK;
+         i < (long long)hi * CHUNK && i < count; i++) {
+      pending |= in[i] >> 16;
+      out[i] = (uint16_t)in[i];
+    }
+    if (pending)
+      rc.store(-2, std::memory_order_relaxed);
+  });
+  return rc.load();
 }
 
 } // extern "C"

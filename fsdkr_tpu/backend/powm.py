@@ -47,24 +47,43 @@ def active_mesh():
 
 # Montgomery contexts keyed by (moduli, limb count, mesh): a refresh reuses
 # the same modulus vectors across many launches (fused prover columns,
-# beta^n, r^e, verifier equations), so the per-row host precompute
-# (n', R^2 mod N) and the modulus tensor upload are paid once per vector,
-# not per launch.
-_CTX_CACHE: dict = {}
-_CTX_CACHE_MAX = 64
+# beta^n, r^e, verifier equations) AND across collect()/distribute() calls
+# of a stable committee, so the per-row host precompute (n', R^2 mod N)
+# and the modulus tensor upload are paid once per vector. Lives in the
+# process-wide bytes-budgeted precompute LRU (utils.lru) alongside the
+# comb window tables; overflow evicts the OLDEST entry only — the old
+# clear()-on-overflow behavior flushed every hot context mid-run. Keyed
+# by a hash prefix with a full moduli-equality check on hit, so a
+# collision can only cost a rebuild, never reuse the wrong constants.
 
 
 def _cached_ctx(moduli, num_limbs):
     from ..ops.montgomery import BatchModExp
+    from ..utils.lru import global_cache
 
-    key = (hash(tuple(moduli)), num_limbs, id(_MESH))
-    ctx = _CTX_CACHE.get(key)
-    if ctx is None or ctx.ctx.moduli != list(moduli):
-        if len(_CTX_CACHE) >= _CTX_CACHE_MAX:
-            _CTX_CACHE.clear()
+    cache = global_cache()
+    key = ("mont-ctx", hash(tuple(moduli)), num_limbs, id(_MESH))
+    ctx = cache.get(key) if cache.budget > 0 else None
+    # the mesh is validated BY IDENTITY on every hit: the cache outlives
+    # apply_mesh reconfigurations, and a recycled id() for a new Mesh
+    # object must rebuild rather than reuse arrays sharded for the old one
+    if ctx is None or ctx.ctx.moduli != list(moduli) or ctx.mesh is not _MESH:
         ctx = BatchModExp(moduli, num_limbs, mesh=_MESH)
-        _CTX_CACHE[key] = ctx
+        if cache.budget > 0:
+            # host arrays: n/r2/one_mont (rows x limbs u32) + n_prime,
+            # roughly doubled for the device copies
+            cache.put(key, ctx, len(moduli) * num_limbs * 4 * 8)
     return ctx
+
+
+def powm_cache_stats():
+    """Counters of the persistent precompute cache (Montgomery contexts,
+    comb window tables, comb power ladders): {entries, bytes, budget,
+    hits, misses, evictions}. The bench battery asserts table-build
+    elimination on warm collects through the hit counter."""
+    from ..utils.lru import cache_stats
+
+    return cache_stats()
 
 
 def _pad_pow2(rows: int) -> int:
@@ -95,7 +114,13 @@ def tpu_modmul(a, b, moduli) -> List[int]:
     """Row-wise a*b mod moduli as one padded multi-modulus launch."""
     if not a:
         return []
-    if not _device_powm():  # CPU fallback: a bigint mulmod is pure C
+    if not _device_powm():  # CPU fallback: a bigint mulmod is pure C —
+        # unless the native row pool has real parallelism to offer
+        # (FSDKR_THREADS > 1), where the threaded Montgomery batch wins
+        from .. import native
+
+        if len(a) >= 64 and native.available() and native.thread_count() > 1:
+            return native.modmul_batch(list(a), list(b), list(moduli))
         return [(x * y) % m for x, y, m in zip(a, b, moduli)]
     from ..ops.limbs import limbs_for_bits
     from ..utils.roofline import modmul_macs
@@ -135,8 +160,21 @@ def _device_powm() -> bool:
 # W = exp_bits/4 windows). At the n=256 collect shape an unchunked
 # launch would need a multi-GB (comb: multi-TB) table, so batches are
 # tiled: generic launches at most _MAX_ROWS rows, comb launches at most
-# _MAX_ROWS table rows (w_cnt * group-chunk), sequential tiles.
+# _MAX_ROWS table rows (w_cnt * group-chunk). Tiles run through the
+# double-buffered pipeline (utils.pipeline): tile k+1's host staging
+# (limb packing, Montgomery entry) overlaps tile k's engine execution;
+# at most two tiles are in flight so the HBM cap still holds at 2x tile.
 _MAX_ROWS = int(_os.environ.get("FSDKR_MAX_ROWS_PER_LAUNCH", "16384"))
+
+
+def _tile_spans(total: int, tile: int):
+    """Row spans of at most `tile` rows, aligned to the active mesh so a
+    cut tile never falls off the sharded path."""
+    if _MESH is not None:
+        from ..parallel.shard_kernels import tile_rows_for_mesh
+
+        tile = tile_rows_for_mesh(tile, _MESH)
+    return [(lo, min(lo + tile, total)) for lo in range(0, total, tile)]
 
 # modulus width classes with prepared RNS bases (caps distinct compiled
 # kernel shapes; moduli bucket up to the nearest class)
@@ -148,12 +186,14 @@ def tpu_powm(bases, exps, moduli) -> List[int]:
         return []
     if not _device_powm():  # CPU fallback: native C++ Montgomery core
         return host_powm(bases, exps, moduli)
-    if len(bases) > _MAX_ROWS:  # HBM tiling: sequential launches
-        out: List[int] = []
-        for lo in range(0, len(bases), _MAX_ROWS):
-            hi = lo + _MAX_ROWS
-            out += tpu_powm(bases[lo:hi], exps[lo:hi], moduli[lo:hi])
-        return out
+    if len(bases) > _MAX_ROWS:  # HBM tiling: double-buffered launches
+        from ..utils.pipeline import pipelined
+
+        parts = pipelined(
+            lambda lo, hi: tpu_powm(bases[lo:hi], exps[lo:hi], moduli[lo:hi]),
+            _tile_spans(len(bases), _MAX_ROWS),
+        )
+        return [v for part in parts for v in part]
     from ..ops.limbs import bucket_exp_bits, limbs_for_bits
     from ..utils.roofline import generic_modexp_macs
     from ..utils.trace import get_tracer
@@ -224,29 +264,36 @@ def tpu_powm_shared(bases, exps_per_group, moduli) -> List[List[int]]:
     # chunk, so tiling terminates for any FSDKR_MAX_ROWS_PER_LAUNCH value
     row_chunk = max(8, 1 << (budget.bit_length() - 1))
     if m_pad > row_chunk:  # huge per-group row counts: tile the row axis
-        parts = []
-        for lo in range(0, m_max, row_chunk):
-            parts.append(
-                tpu_powm_shared(
-                    bases,
-                    [e[lo : lo + row_chunk] for e in exps_per_group],
-                    moduli,
-                )
-            )
+        from ..utils.pipeline import pipelined
+
+        parts = pipelined(
+            lambda lo, hi: tpu_powm_shared(
+                bases, [e[lo:hi] for e in exps_per_group], moduli
+            ),
+            [
+                (lo, lo + row_chunk)
+                for lo in range(0, m_max, row_chunk)
+            ],
+        )
         return [
             [v for part in parts for v in part[i]] for i in range(len(bases))
         ]
     g_cap = max(
         1, 1 << max(0, min(budget // w_cnt, budget // m_pad).bit_length() - 1)
     )
-    if len(bases) > g_cap:  # HBM tiling over group chunks
-        out: List[List[int]] = []
-        for lo in range(0, len(bases), g_cap):
-            hi = lo + g_cap
-            out += tpu_powm_shared(
+    if len(bases) > g_cap:  # HBM tiling over group chunks, double-buffered
+        from ..utils.pipeline import pipelined
+
+        parts = pipelined(
+            lambda lo, hi: tpu_powm_shared(
                 bases[lo:hi], exps_per_group[lo:hi], moduli[lo:hi]
-            )
-        return out
+            ),
+            [
+                (lo, min(lo + g_cap, len(bases)))
+                for lo in range(0, len(bases), g_cap)
+            ],
+        )
+        return [grp for part in parts for grp in part]
     g = len(bases)
     g_pad = max(2, 1 << (g - 1).bit_length())
     if _MESH is not None:
@@ -382,14 +429,16 @@ def _device_joint_launch(bases_rows, exps_rows, moduli, k) -> List[int]:
     from ..utils.trace import get_tracer
 
     rows = len(moduli)
-    if rows > _MAX_ROWS:  # HBM tiling: sequential launches
-        out: List[int] = []
-        for lo in range(0, rows, _MAX_ROWS):
-            hi = lo + _MAX_ROWS
-            out += _device_joint_launch(
+    if rows > _MAX_ROWS:  # HBM tiling: double-buffered launches
+        from ..utils.pipeline import pipelined
+
+        parts = pipelined(
+            lambda lo, hi: _device_joint_launch(
                 bases_rows[lo:hi], exps_rows[lo:hi], moduli[lo:hi], k
-            )
-        return out
+            ),
+            _tile_spans(rows, _MAX_ROWS),
+        )
+        return [v for part in parts for v in part]
     pad = _pad_pow2(rows) - rows
     bases_rows = list(bases_rows) + [(1,) * k] * pad
     exps_rows = list(exps_rows) + [(0,) * k] * pad
@@ -680,10 +729,19 @@ def powm_columns(powm: BatchPowm, *columns):
         m += list(moduli)
 
     out: list = [None] * len(columns)
-    for b, e, m, spans in flat.values():
-        res = powm(b, e, m)
-        for col, lo, hi in spans:
-            out[col] = res[lo:hi]
+    # width buckets are independent launches: run them through the
+    # double-buffered pipeline so one bucket's host staging overlaps
+    # another's engine execution (results land by span, order-exact)
+    jobs = list(flat.values())
+    if jobs:
+        from ..utils.pipeline import pipelined
+
+        results = pipelined(
+            lambda b, e, m: powm(b, e, m), [(b, e, m) for b, e, m, _ in jobs]
+        )
+        for (_, _, _, spans), res in zip(jobs, results):
+            for col, lo, hi in spans:
+                out[col] = res[lo:hi]
     if multi:
         # host backend always takes host engines; the tpu backend follows
         # the platform routing (native core on XLA:CPU, kernels on chip)

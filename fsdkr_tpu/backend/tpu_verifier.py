@@ -175,8 +175,11 @@ class TpuBatchVerifier(BatchVerifier):
         cols = nt_cols + (multi,)
         return cols, (e_vec, nn_mod, nt_mod, row_ok, inv_fail)
 
-    def _pdl_finish(self, items, state, results):
-        """Combine the modexp column results into per-row verdicts."""
+    def _pdl_finish(self, items, state, results, u1_vec=None):
+        """Combine the modexp column results into per-row verdicts.
+        u1_vec carries the EC u1 column when the caller overlapped it
+        with the modexp launches (pipeline mode); None computes it here
+        (the pdl.ec_u1 phase then measures compute, not just the join)."""
         e_vec, nn_mod, nt_mod, row_ok, inv_fail = state
         with phase("pdl.combine", items=len(items)):
             gs1 = [
@@ -214,7 +217,10 @@ class TpuBatchVerifier(BatchVerifier):
             rhs3 = _modmul(h1_s1, h2_s3, nt_mod)
 
         with phase("pdl.ec_u1", items=len(items)):
-            ok1_vec = self._pdl_u1_batch(items, e_vec)
+            ok1_vec = (
+                u1_vec if u1_vec is not None
+                else self._pdl_u1_batch(items, e_vec)
+            )
 
         out = []
         for idx, (proof, st) in enumerate(items):
@@ -227,12 +233,21 @@ class TpuBatchVerifier(BatchVerifier):
     def verify_pdl(self, items):
         if not items:
             return []
+        from ..utils.pipeline import submit_bg
         from .powm import multiexp_enabled, powm_columns
 
         cols, state = self._pdl_prepare(items, joint=multiexp_enabled())
+        # the EC u1 column needs only (items, e_vec), both fixed before
+        # any launch: run it on a background thread so the host EC work
+        # hides behind the modexp columns' engine time
+        e_vec = state[0]
+        u1_fut = submit_bg(lambda: self._pdl_u1_batch(items, e_vec))
         with phase("pdl.modexp_columns", items=len(cols) * len(items)):
             results = powm_columns(_modexp, *cols)
-        return self._pdl_finish(items, state, results)
+        return self._pdl_finish(
+            items, state, results,
+            u1_vec=u1_fut.result() if u1_fut is not None else None,
+        )
 
     def _pdl_u1_batch(self, items, e_vec) -> List[bool]:
         """u1 == s1*G - e*Q per row (`src/zk_pdl_with_slack.rs:124-127`),
@@ -493,16 +508,24 @@ class TpuBatchVerifier(BatchVerifier):
         small committees underfeed the chip."""
         if not pdl_items or not range_items:
             return super().verify_pairs(pdl_items, range_items)
+        from ..utils.pipeline import submit_bg
         from .powm import multiexp_enabled, powm_columns
 
         joint = multiexp_enabled()
         pcols, state = self._pdl_prepare(pdl_items, joint=joint)
         rcols, rmods = self._range_prepare(range_items, joint=joint)
+        # overlap the host EC u1 column with the fused modexp launch set
+        # (see verify_pdl)
+        e_vec = state[0]
+        u1_fut = submit_bg(lambda: self._pdl_u1_batch(pdl_items, e_vec))
         n_rows = len(pcols) * len(pdl_items) + len(rcols) * len(range_items)
         with phase("pairs.modexp_columns", items=n_rows):
             results = powm_columns(_modexp, *pcols, *rcols)
         return (
-            self._pdl_finish(pdl_items, state, results[: len(pcols)]),
+            self._pdl_finish(
+                pdl_items, state, results[: len(pcols)],
+                u1_vec=u1_fut.result() if u1_fut is not None else None,
+            ),
             self._range_finish(range_items, rmods, results[len(pcols) :]),
         )
 

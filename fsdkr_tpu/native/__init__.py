@@ -25,7 +25,11 @@ __all__ = [
     "modexp_batch",
     "modexp_shared",
     "multi_modexp_batch",
+    "modmul_batch",
     "is_probable_prime",
+    "widen_limbs",
+    "narrow_limbs",
+    "thread_count",
 ]
 
 _LIMB_BYTES = 8
@@ -37,8 +41,32 @@ _LIB = _loader.get_lib(
     "_fsdkr_native",
     ("fsdkr_modexp", "fsdkr_modexp_w", "fsdkr_modexp_batch",
      "fsdkr_modexp_batch_w", "fsdkr_modexp_shared", "fsdkr_modexp_shared_w",
-     "fsdkr_multi_modexp_batch", "fsdkr_miller_rabin"),
+     "fsdkr_multi_modexp_batch", "fsdkr_miller_rabin", "fsdkr_modmul_batch",
+     "fsdkr_comb_table_words", "fsdkr_comb_precompute", "fsdkr_comb_apply",
+     "fsdkr_limbs_widen_u16", "fsdkr_limbs_narrow_u16",
+     "fsdkr_set_threads", "fsdkr_get_threads"),
+    thread_symbol="fsdkr_set_threads",
 )
+
+
+def thread_count() -> int:
+    """The row-parallel thread count the native cores will use (after
+    FSDKR_THREADS resolution; 1 when the library is unavailable)."""
+    lib = _get()
+    if lib is None:
+        return 1
+    _LIB.sync_threads()
+    return int(lib.fsdkr_get_threads())
+
+
+def _tile_rows() -> int:
+    """Row-tile size for the pipelined batch entry points (0 disables
+    tiling). Staging of tile k+1 (the Python-side bigint -> limb packing)
+    overlaps the GIL-released native execution of tile k."""
+    try:
+        return int(os.environ.get("FSDKR_TILE_ROWS", "512"))
+    except ValueError:
+        return 512
 
 
 def _gen_window_bits(total_exp_bits: int, terms: int = 1) -> int:
@@ -131,12 +159,28 @@ def modexp_batch(
 ) -> List[int]:
     """Row-wise bases^exps mod mods. Rows are padded to the widest modulus
     and exponent in the batch; even/oversized-modulus rows fall back to
-    CPython pow row-wise."""
+    CPython pow row-wise. Large batches split into FSDKR_TILE_ROWS tiles
+    run through the double-buffered pipeline: tile k+1's limb staging
+    overlaps tile k's (GIL-released) native execution, and each tile's
+    rows additionally split across the FSDKR_THREADS row pool."""
     if not bases:
         return []
     if not (len(bases) == len(exps) == len(mods)):
         raise ValueError("batch length mismatch")
+    rows = len(bases)
+    tile = _tile_rows()
+    if tile > 0 and rows > tile:
+        from ..utils.pipeline import pipelined
+
+        bases, exps, mods = list(bases), list(exps), list(mods)
+        spans = [(lo, min(lo + tile, rows)) for lo in range(0, rows, tile)]
+        parts = pipelined(
+            lambda lo, hi: modexp_batch(bases[lo:hi], exps[lo:hi], mods[lo:hi]),
+            spans,
+        )
+        return [v for part in parts for v in part]
     lib = _get()
+    _LIB.sync_threads()
     L = max(_limbs_for(m) for m in mods)
     if (
         lib is None
@@ -177,14 +221,50 @@ def _comb_window_bits(ebits: int, m_rows: int) -> int:
     return best
 
 
+def _cached_comb_table(lib, base_red: int, mod: int, L: int, EL: int, wbits: int):
+    """Comb window table for (base, modulus, geometry) from the
+    process-wide persistent cache (utils.lru), building and inserting on
+    miss. The table derives ONLY from the public base/modulus — no
+    exponent ever enters it — so it is safe to keep across collect()
+    calls; callers with a SECRET base must pass cache=False to
+    modexp_shared and ride the one-shot wiped path instead. Returns None
+    when caching is disabled (budget 0) or the build fails."""
+    from ..utils.lru import global_cache
+
+    cache = global_cache()
+    if cache.budget <= 0:
+        return None
+    key = ("native-comb", base_red, mod, EL, wbits)
+    tbl = cache.get(key)
+    if tbl is not None:
+        return tbl
+    words = lib.fsdkr_comb_table_words(L, EL, wbits)
+    if words <= 0:
+        return None
+    tbl = (ctypes.c_uint64 * words)()
+    base_buf = _to_buf([base_red], L)
+    mod_buf = _to_buf([mod], L)
+    rc = lib.fsdkr_comb_precompute(base_buf, mod_buf, tbl, L, EL, wbits)
+    _wipe_buf(base_buf, mod_buf)
+    if rc != 0:
+        return None
+    cache.put(key, tbl, words * _LIMB_BYTES)
+    return tbl
+
+
 def modexp_shared(
-    base: int, exps: Sequence[int], mod: int
+    base: int, exps: Sequence[int], mod: int, cache: bool = True
 ) -> List[int]:
     """base^exps[i] mod mod via the fixed-base comb — the shared-base
     column shape of the verify loop (one squaring ladder amortized over
-    the whole group; window width chosen by group shape). Falls back to
-    CPython pow when native is unavailable or the modulus is
-    even/oversized."""
+    the whole group; window width chosen by group shape; rows split
+    across the FSDKR_THREADS pool). With cache=True (all in-repo callers:
+    their bases are public ring-Pedersen parameters h1/h2/T) the window
+    table persists in the bytes-budgeted LRU keyed by (base, modulus,
+    geometry), so steady-state refreshes of a stable committee skip the
+    build entirely; cache=False keeps the old build-use-wipe path for
+    secret bases. Falls back to CPython pow when native is unavailable
+    or the modulus is even/oversized."""
     if not exps:
         return []
     lib = _get()
@@ -194,12 +274,28 @@ def modexp_shared(
     EL = max(1, max(_limbs_for(e) for e in exps))
     if EL > 2 * _MAX_LIMBS:  # comb table would be attacker-sized
         return [pow(base, e, mod) for e in exps]
+    _LIB.sync_threads()
     m_rows = len(exps)
     wbits = _comb_window_bits(EL * 64, m_rows)
     out = (ctypes.c_uint64 * (m_rows * L))()
-    base_buf = _to_buf([base % mod], L)
     exp_buf = _to_buf(list(exps), EL)
     mod_buf = _to_buf([mod], L)
+    table = (
+        _cached_comb_table(lib, base % mod, mod, L, EL, wbits)
+        if cache
+        else None
+    )
+    if table is not None:
+        rc = lib.fsdkr_comb_apply(
+            table, exp_buf, mod_buf, out, m_rows, L, EL, wbits
+        )
+        if rc == 0:
+            res = _from_buf(out, m_rows, L)
+            _wipe_buf(exp_buf, mod_buf, out)
+            return res
+        # geometry rejected (cannot normally happen once cached): fall
+        # through to the one-shot path below
+    base_buf = _to_buf([base % mod], L)
     rc = lib.fsdkr_modexp_shared_w(
         base_buf, exp_buf, mod_buf, out, m_rows, L, EL, wbits
     )
@@ -233,7 +329,24 @@ def multi_modexp_batch(
     k = len(bases[0])
     if any(len(b) != k or len(e) != k for b, e in zip(bases, exps)):
         raise ValueError("multi-exponentiation rows must share a term count")
+    tile = _tile_rows()
+    if tile > 0 and len(mods) > tile:  # see modexp_batch: staged pipeline
+        from ..utils.pipeline import pipelined
+
+        bases, exps, mods = list(bases), list(exps), list(mods)
+        spans = [
+            (lo, min(lo + tile, len(mods)))
+            for lo in range(0, len(mods), tile)
+        ]
+        parts = pipelined(
+            lambda lo, hi: multi_modexp_batch(
+                bases[lo:hi], exps[lo:hi], mods[lo:hi]
+            ),
+            spans,
+        )
+        return [v for part in parts for v in part]
     lib = _get()
+    _LIB.sync_threads()
     L = max(_limbs_for(m) for m in mods)
     # per-term exponent widths: launch-wide column shape (max bit length
     # of the term position), so the shared chain and each term's window
@@ -283,14 +396,100 @@ def multi_modexp_batch(
     return res
 
 
+def modmul_batch(
+    a: Sequence[int], b: Sequence[int], mods: Sequence[int]
+) -> List[int]:
+    """Row-wise a*b mod mods via the native Montgomery core, rows split
+    across the FSDKR_THREADS pool. Rows are sorted by modulus before the
+    native call (and scattered back) so the per-modulus Montgomery
+    constants amortize over each receiver's whole row group; CPython
+    mulmod fallback when native is unavailable or a modulus is
+    even/oversized."""
+    if not a:
+        return []
+    if not (len(a) == len(b) == len(mods)):
+        raise ValueError("batch length mismatch")
+    lib = _get()
+    L = max(_limbs_for(m) for m in mods)
+    if (
+        lib is None
+        or L > _MAX_LIMBS
+        or any(m % 2 == 0 or m <= 1 for m in mods)
+    ):
+        return [x * y % m for x, y, m in zip(a, b, mods)]
+    _LIB.sync_threads()
+    order = sorted(range(len(mods)), key=lambda i: mods[i])
+    rows = len(order)
+    out = (ctypes.c_uint64 * (rows * L))()
+    a_buf = _to_buf([a[i] % mods[i] for i in order], L)
+    b_buf = _to_buf([b[i] % mods[i] for i in order], L)
+    mod_buf = _to_buf([mods[i] for i in order], L)
+    rc = lib.fsdkr_modmul_batch(a_buf, b_buf, mod_buf, out, rows, L)
+    if rc != 0:
+        _wipe_buf(a_buf, b_buf, mod_buf, out)
+        return [x * y % m for x, y, m in zip(a, b, mods)]
+    sorted_res = _from_buf(out, rows, L)
+    _wipe_buf(a_buf, b_buf, mod_buf, out)
+    res: List[int] = [0] * rows
+    for pos, i in enumerate(order):
+        res[i] = sorted_res[pos]
+    return res
+
+
+def widen_limbs(arr16):
+    """u16 -> u32 limb widening (the device kernels' staging layout)
+    through the native threaded pass; None when the core is unavailable
+    (ops.limbs falls back to numpy astype). The input is NOT wiped here —
+    ints_to_limbs owns the staging-wipe discipline for both paths."""
+    lib = _get()
+    if lib is None:
+        return None
+    import numpy as np
+
+    src = np.ascontiguousarray(arr16, dtype=np.uint16)
+    out = np.empty(src.shape, dtype=np.uint32)
+    _LIB.sync_threads()
+    lib.fsdkr_limbs_widen_u16(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        ctypes.c_longlong(src.size),
+    )
+    return out
+
+
+def narrow_limbs(arr32):
+    """u32 -> u16 limb narrowing with the canonicality check fused into
+    the same threaded pass (one sweep instead of numpy's check + astype).
+    Returns None when the core is unavailable; raises ValueError on a
+    pending-carry limb exactly like ops.limbs.limbs_to_ints."""
+    lib = _get()
+    if lib is None:
+        return None
+    import numpy as np
+
+    src = np.ascontiguousarray(arr32, dtype=np.uint32)
+    out = np.empty(src.shape, dtype=np.uint16)
+    _LIB.sync_threads()
+    rc = lib.fsdkr_limbs_narrow_u16(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        ctypes.c_longlong(src.size),
+    )
+    if rc != 0:
+        raise ValueError("limb array not canonical (pending carries)")
+    return out
+
+
 def is_probable_prime(n: int, rounds: int = 30) -> Optional[bool]:
-    """Miller-Rabin with CSPRNG witnesses, native squaring loop. Returns
-    None when the native path cannot handle the input (caller falls back
-    to the Python implementation)."""
+    """Miller-Rabin with CSPRNG witnesses, native squaring loop (rounds
+    split across the FSDKR_THREADS pool). Returns None when the native
+    path cannot handle the input (caller falls back to the Python
+    implementation)."""
     lib = _get()
     L = _limbs_for(n)
     if lib is None or L > _MAX_LIMBS or n < 5 or n % 2 == 0:
         return None
+    _LIB.sync_threads()
     witnesses = [2 + secrets.randbelow(n - 3) for _ in range(rounds)]
     n_buf = _to_buf([n], L)  # prime candidate: secret key material
     rc = lib.fsdkr_miller_rabin(n_buf, L, _to_buf(witnesses, L), rounds)

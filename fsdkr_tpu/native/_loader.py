@@ -55,11 +55,14 @@ class NativeLib:
         prefix: str,
         symbols: Sequence[str],
         env_var: Optional[str] = None,
+        thread_symbol: Optional[str] = None,
     ):
         self._src = src
         self._prefix = prefix
         self._symbols = list(symbols)
         self._env_var = env_var
+        self._thread_symbol = thread_symbol
+        self._applied_threads: Optional[str] = None
         self._lib: Optional[ctypes.CDLL] = None
         self._tried = False
         self._lock = threading.Lock()
@@ -86,9 +89,12 @@ class NativeLib:
                 suffix=".so", prefix="_fsdkr_build_", dir=os.path.dirname(so)
             )
             os.close(fd)
+            # -pthread is load-bearing on glibc < 2.34 (this image ships
+            # 2.31): std::thread in a dlopened .so without it aborts at
+            # the first spawn instead of failing the link
             cmd = [
                 "g++", "-O3", "-march=native", "-shared", "-fPIC",
-                "-o", tmp, src,
+                "-pthread", "-o", tmp, src,
             ]
             try:
                 subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -127,6 +133,27 @@ class NativeLib:
     def available(self) -> bool:
         return self.get() is not None
 
+    def sync_threads(self) -> None:
+        """Apply FSDKR_THREADS to the core's row-parallel batch loops
+        (0/auto = all cores, 1 = serial; results are bit-identical at
+        any setting — see parallel_rows in the C++ sources). Read at
+        call time so the bench battery can toggle it per step; a benign
+        read/apply race just re-applies the same value."""
+        if self._thread_symbol is None:
+            return
+        lib = self.get()
+        if lib is None:
+            return
+        val = os.environ.get("FSDKR_THREADS", "0").strip().lower() or "0"
+        if val == self._applied_threads:
+            return
+        try:
+            n = int(val)
+        except ValueError:
+            n = 0  # "auto" (or anything unparseable) -> all cores
+        getattr(lib, self._thread_symbol)(n)
+        self._applied_threads = val
+
 
 _REGISTRY: Dict[str, NativeLib] = {}
 
@@ -136,9 +163,12 @@ def get_lib(
     prefix: str,
     symbols: Sequence[str],
     env_var: Optional[str] = None,
+    thread_symbol: Optional[str] = None,
 ) -> NativeLib:
     """Process-wide NativeLib per prefix (so repeated imports share one
     build attempt)."""
     if prefix not in _REGISTRY:
-        _REGISTRY[prefix] = NativeLib(src, prefix, symbols, env_var)
+        _REGISTRY[prefix] = NativeLib(
+            src, prefix, symbols, env_var, thread_symbol
+        )
     return _REGISTRY[prefix]

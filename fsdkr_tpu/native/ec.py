@@ -43,13 +43,20 @@ _LIB = _loader.get_lib(
         "fsdkr_ec_horner_batch",
         "fsdkr_ec_scalar_mul_batch",
         "fsdkr_ec_lincomb2_batch",
+        "fsdkr_ec_set_threads",
     ),
     env_var="FSDKR_NATIVE_EC",
+    thread_symbol="fsdkr_ec_set_threads",
 )
 
 
 def _get() -> Optional[ctypes.CDLL]:
-    return _LIB.get()
+    # every entry point is a batch over independent rows: sync the
+    # FSDKR_THREADS row pool alongside the lazy load
+    lib = _LIB.get()
+    if lib is not None:
+        _LIB.sync_threads()
+    return lib
 
 
 def available() -> bool:

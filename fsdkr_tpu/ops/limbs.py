@@ -30,6 +30,12 @@ __all__ = [
 
 WINDOW_BITS = 4  # fixed-window width of the modexp kernels
 
+# Below this many limbs the numpy passes win (ctypes call overhead);
+# above it the native threaded widen/narrow passes (csrc/fsdkr_native
+# fsdkr_limbs_widen_u16 / fsdkr_limbs_narrow_u16) take over, so tile
+# staging scales with the FSDKR_THREADS row pool.
+_NATIVE_STAGE_MIN_LIMBS = 4096
+
 # Exponent-width ladder: modexp wall-clock is proportional to the bucketed
 # width (sequential window loop), so the ladder is finer than powers of two
 # where the protocol's exponent sizes actually fall (q*Ntilde ~ 2304 bits,
@@ -79,12 +85,18 @@ def ints_to_limbs(xs: Sequence[int], num_limbs: int) -> np.ndarray:
             raise ValueError(
                 f"integer of {x.bit_length()} bits exceeds {num_limbs} limbs"
             ) from None
-    out = (
-        np.frombuffer(buf, dtype="<u2")
-        .reshape(len(xs), num_limbs)
-        .astype(np.uint32)
-    )
-    buf[:] = bytes(len(buf))  # wipe staging bytes
+    arr16 = np.frombuffer(buf, dtype="<u2").reshape(len(xs), num_limbs)
+    out = None
+    if arr16.size >= _NATIVE_STAGE_MIN_LIMBS:
+        try:
+            from .. import native
+
+            out = native.widen_limbs(arr16)  # threaded u16 -> u32 pass
+        except Exception:
+            out = None
+    if out is None:
+        out = arr16.astype(np.uint32)
+    buf[:] = bytes(len(buf))  # wipe staging bytes (out never aliases buf)
     return out
 
 
@@ -103,9 +115,24 @@ def limbs_to_ints(arr) -> List[int]:
     a = np.asarray(arr)
     if a.ndim != 2:
         raise ValueError("expected a (B, K) limb array")
-    if (a >> LIMB_BITS).any():
-        raise ValueError("limb array not canonical (pending carries)")
-    raw = a.astype("<u2").tobytes()
+    raw = None
+    if a.size >= _NATIVE_STAGE_MIN_LIMBS:
+        try:
+            from .. import native
+
+            # one threaded pass fusing the canonicality check with the
+            # narrow (raises ValueError itself on pending carries)
+            a16 = native.narrow_limbs(a)
+        except ValueError:
+            raise
+        except Exception:
+            a16 = None
+        if a16 is not None:
+            raw = a16.astype("<u2", copy=False).tobytes()
+    if raw is None:
+        if (a >> LIMB_BITS).any():
+            raise ValueError("limb array not canonical (pending carries)")
+        raw = a.astype("<u2").tobytes()
     nbytes = a.shape[1] * (LIMB_BITS // 8)
     return [
         int.from_bytes(raw[i * nbytes : (i + 1) * nbytes], "little")

@@ -620,15 +620,30 @@ def shared_base_modexp(
     powers = None
     if host_ladder:
         from ..core import intops
+        from ..utils.lru import global_cache
 
         w_cnt = exp_bits // _WINDOW
         r = 1 << (LIMB_BITS * num_limbs)
+        # the per-group power ladder is a pure function of the PUBLIC
+        # (base, modulus) pair and the launch geometry — persist it in
+        # the precompute LRU so steady-state refreshes of a stable
+        # committee (same h1/h2/T bases) skip the ~10 ms/group host
+        # ladder entirely (cache-isolation pinned by test_cache_isolation)
+        cache = global_cache()
         flat_powers: List[int] = []
         for b, n in zip(bases, ctx.moduli):
-            p = b % n
-            for _ in range(w_cnt):
-                flat_powers.append(p * r % n)  # Montgomery domain
-                p = intops.mod_pow(p, 1 << _WINDOW, n)
+            key = ("comb-powers", b % n, n, w_cnt, num_limbs)
+            pws = cache.get(key) if cache.budget > 0 else None
+            if pws is None:
+                p = b % n
+                pws = []
+                for _ in range(w_cnt):
+                    pws.append(p * r % n)  # Montgomery domain
+                    p = intops.mod_pow(p, 1 << _WINDOW, n)
+                pws = tuple(pws)
+                if cache.budget > 0:
+                    cache.put(key, pws, w_cnt * (num_limbs * 2 + 48))
+            flat_powers.extend(pws)
         powers = jnp.asarray(
             ints_to_limbs(flat_powers, num_limbs)
             .reshape(g_cnt, w_cnt, num_limbs)

@@ -22,6 +22,7 @@ from jax.sharding import PartitionSpec as P
 
 __all__ = [
     "padded_rows",
+    "tile_rows_for_mesh",
     "shard_map_compat",
     "sharded_modexp_fn",
     "sharded_modmul_fn",
@@ -54,6 +55,17 @@ def padded_rows(rows: int, mesh) -> int:
     """Round `rows` up so it splits evenly across the mesh."""
     n_dev = int(mesh.devices.size)
     return -(-rows // n_dev) * n_dev
+
+
+def tile_rows_for_mesh(tile_rows: int, mesh) -> int:
+    """Round a pipeline tile size DOWN to a device-count multiple (but
+    never below one row per device): the double-buffered dispatch in
+    backend.powm cuts batches at tile boundaries, and a tile that does
+    not divide across the mesh would silently fall off the sharded path
+    inside the engines (`rows % devices == 0` gate) onto single-device
+    execution."""
+    n_dev = int(mesh.devices.size)
+    return max(n_dev, (tile_rows // n_dev) * n_dev)
 
 
 @lru_cache(maxsize=128)

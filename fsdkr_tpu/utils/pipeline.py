@@ -1,0 +1,92 @@
+"""Tile pipelining for the batched launch paths.
+
+A large batch is split into row tiles (HBM caps on the device path, L2
+and staging-buffer pressure on the native path). Running the tiles
+strictly one after another serializes host staging (bigint -> limb
+packing, base inversions, Montgomery-domain entry) against engine
+execution, even though the engine releases the GIL for the whole call
+(ctypes native calls) or returns before the device finishes (async JAX
+dispatch). `pipelined` keeps a bounded window of tiles in flight on a
+small thread pool, so tile k+1's staging overlaps tile k's engine time —
+the dataflow shape SZKP-style pipelines get their throughput from.
+
+Determinism: every tile is an independent slice with its own output
+slot; results are reassembled by index, so the output is bit-identical
+to the sequential loop at any depth. FSDKR_PIPELINE=0 forces the
+sequential loop (A/B isolation and debugging).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Sequence
+
+__all__ = ["pipeline_enabled", "pipelined", "submit_bg"]
+
+_DEPTH = 2  # double-buffered: one tile staging while one executes
+
+
+def pipeline_enabled() -> bool:
+    return os.environ.get("FSDKR_PIPELINE", "1").lower() not in (
+        "0", "off", "false", "no",
+    )
+
+
+def pipelined(run: Callable, args_list: Sequence[tuple], depth: int = _DEPTH) -> List:
+    """run(*args) for each tuple in args_list, up to `depth` tiles in
+    flight, results in submission order. Exceptions propagate (the first
+    failing tile's error; later in-flight tiles are drained first).
+    Worker threads inherit the submitting thread's tracer phase, so MAC
+    accounting (utils.trace add_macs) stays attributed correctly."""
+    n = len(args_list)
+    if n <= 1 or depth <= 1 or not pipeline_enabled():
+        return [run(*a) for a in args_list]
+    from concurrent.futures import ThreadPoolExecutor
+
+    from .trace import get_tracer
+
+    tracer = get_tracer()
+    phase_name = tracer.current_phase()
+
+    def worker(*args):
+        with tracer.inherit_phase(phase_name):
+            return run(*args)
+
+    out: List = [None] * n
+    with ThreadPoolExecutor(max_workers=depth) as ex:
+        futs = {}
+        nxt = 0
+        for _ in range(min(depth, n)):
+            futs[nxt] = ex.submit(worker, *args_list[nxt])
+            nxt += 1
+        for i in range(n):
+            out[i] = futs.pop(i).result()
+            if nxt < n:
+                futs[nxt] = ex.submit(worker, *args_list[nxt])
+                nxt += 1
+    return out
+
+
+def submit_bg(fn: Callable) -> Optional["object"]:
+    """Run fn() on a single background thread, returning its Future —
+    used to overlap an independent host computation (the PDL u1 EC
+    column) with the modexp launch set. Returns None when pipelining is
+    disabled; callers then run fn inline at the join point. The worker
+    inherits the submitting thread's tracer phase (see pipelined)."""
+    if not pipeline_enabled():
+        return None
+    from concurrent.futures import ThreadPoolExecutor
+
+    from .trace import get_tracer
+
+    tracer = get_tracer()
+    phase_name = tracer.current_phase()
+
+    def worker():
+        with tracer.inherit_phase(phase_name):
+            return fn()
+
+    ex = ThreadPoolExecutor(max_workers=1)
+    fut = ex.submit(worker)
+    ex.shutdown(wait=False)  # the future still completes; no leak
+    return fut

@@ -91,6 +91,29 @@ class Tracer:
             stack = self._local.stack = []
         return stack
 
+    def current_phase(self) -> Optional[str]:
+        """Innermost active phase of THIS thread (None outside any)."""
+        stack = getattr(self._local, "stack", None)
+        return stack[-1] if stack else None
+
+    @contextlib.contextmanager
+    def inherit_phase(self, name: Optional[str]) -> Iterator[None]:
+        """Attribute work on a worker thread to the submitting thread's
+        phase: pushes `name` onto this thread's phase stack WITHOUT
+        timing it (the submitter's enclosing `phase` already owns the
+        wall clock; a timed re-entry would double-count seconds). Used
+        by utils.pipeline so add_macs from pipelined tiles lands in the
+        right phase instead of \"(unphased)\"."""
+        if not self.enabled or name is None:
+            yield
+            return
+        stack = self._phase_stack()
+        stack.append(name)
+        try:
+            yield
+        finally:
+            stack.pop()
+
     def add_macs(self, macs: float) -> None:
         """Attribute analytic device work (utils.roofline formulas) to the
         innermost active phase of this thread — the kernel launch layer

@@ -30,4 +30,12 @@ python scripts/lint_imports.py fsdkr_tpu tests scripts bench.py __graft_entry__.
 echo "== test: smoke tier =="
 python -m pytest tests/ -q -m "not slow and not heavy" -p no:cacheprovider
 
+echo "== test: thread parity (row pool forced >1) =="
+# the smoke tier above already ran these files at the default thread
+# setting; this pass forces an 8-wide native row pool so the concurrent
+# path is exercised on every commit, not just on many-core bench hosts
+FSDKR_THREADS=8 python -m pytest tests/test_thread_parity.py \
+  tests/test_cache_isolation.py -q -m "not slow and not heavy" \
+  -p no:cacheprovider
+
 echo "== ci.sh: all gates green =="

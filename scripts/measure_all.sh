@@ -52,6 +52,25 @@ run() {  # name, timeout, [VAR=V ...] cmd args...   (no '--': env treats
   echo "rc=$rc tail:"; tail -3 "$R/m_$name.log"; cat "$R/m_$name.json" 2>/dev/null
 }
 
+run_local() {  # like run, but never touches the tunnel: for host-path
+  # steps (BENCH_PLATFORM=cpu) that must proceed through an outage
+  name=$1; to=$2; shift 2
+  if [ -e "$R/m_$name.ok" ] && [ -s "$R/m_$name.json" ]; then
+    echo "=== $name already measured, skipping ==="
+    return
+  fi
+  echo "=== $name ($(date +%H:%M:%S)) ==="
+  timeout "$to" env "$@" > "$R/m_$name.json" 2> "$R/m_$name.log"
+  rc=$?
+  if [ "$rc" = 0 ] && ! grep -q '"error"' "$R/m_$name.json"; then
+    touch "$R/m_$name.ok"
+  else
+    mv "$R/m_$name.json" "$R/m_$name.json.failed"
+    [ "$rc" = 0 ] && rc=error-in-json
+  fi
+  echo "rc=$rc tail:"; tail -3 "$R/m_$name.log"; cat "$R/m_$name.json" 2>/dev/null
+}
+
 # Chipless AOT preflight before any tunnel time: every jitted call a
 # refresh makes must lower for TPU (Mosaic included). Two round-5
 # hardware-only compile failures motivated this. On failure, degrade
@@ -132,4 +151,27 @@ run n64_hostec 3600 BENCH_N=64 BENCH_T=32 FSDKR_DEVICE_EC=0 FSDKR_TRACE=1 python
 # the per-term column path on identical kernels; CPU-platform pair is in
 # BASELINE.md round 6)
 run n16_nomultiexp 2400 FSDKR_MULTIEXP=0 FSDKR_TRACE=1 python bench.py
+
+# host-engine thread scaling (FSDKR_THREADS row pool; 1 = the historical
+# serial loop, auto = all cores). Pinned to the CPU platform + host
+# routes so the series isolates the native engines and survives a tunnel
+# outage; the warm collect's powm_cache field in each JSON shows the
+# persistent-table hit counts (second collect of the same committee must
+# show the table builds eliminated).
+for T in 1 4 8 auto; do
+  run_local "n16_host_t$T" 3600 BENCH_PLATFORM=cpu FSDKR_THREADS=$T \
+    FSDKR_DEVICE_POWM=0 FSDKR_DEVICE_EC=0 FSDKR_TRACE=1 python bench.py
+done
+
+# canonical BENCH datapoint from the battery, copied to the repo root so
+# the round's bench trajectory is populated even if the driver never
+# runs bench.py itself: prefer the on-chip n16 step, fall back to the
+# host-path auto-thread step
+for src in n16 n16_host_tauto; do
+  if [ -e "$R/m_$src.ok" ] && [ -s "$R/m_$src.json" ]; then
+    cp "$R/m_$src.json" /root/repo/BENCH_battery.json
+    echo "canonical datapoint: $src -> BENCH_battery.json"
+    break
+  fi
+done
 echo "=== battery done ==="

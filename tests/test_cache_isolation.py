@@ -1,0 +1,208 @@
+"""Persistent precompute cache: budget/eviction semantics and — the
+security-relevant tier-1 pin — isolation across interleaved committees.
+
+The cache (utils.lru) holds comb window tables, comb power ladders, and
+Montgomery contexts keyed by full public values (base, modulus,
+geometry). Interleaving collects of two DIFFERENT committees must
+produce results identical to cold-cache runs: a hit under one
+committee's key can never serve another's math. The unit layer checks
+the engines value-for-value; the collect layer checks accept/reject
+verdicts (honest accept + tampered reject) warm vs cold.
+"""
+
+import copy
+import dataclasses
+import random
+
+import pytest
+
+from fsdkr_tpu import native
+from fsdkr_tpu.utils.lru import (
+    BudgetLRU,
+    cache_stats,
+    clear_caches,
+    global_cache,
+)
+
+RNG = random.Random(0xCACE)
+
+
+def _odd_mod(bits):
+    return RNG.getrandbits(bits) | (1 << (bits - 1)) | 1
+
+
+# ---------------------------------------------------------------------------
+# LRU semantics (the _CTX_CACHE clear()-on-overflow fix)
+
+
+def test_lru_evicts_oldest_not_all():
+    lru = BudgetLRU(100)
+    lru.put("a", 1, 40)
+    lru.put("b", 2, 40)
+    assert lru.get("a") == 1  # refresh a: b is now oldest
+    lru.put("c", 3, 40)  # overflow: evict b ONLY
+    assert lru.get("b") is None
+    assert lru.get("a") == 1 and lru.get("c") == 3
+    assert lru.stats()["evictions"] == 1
+
+
+def test_lru_budget_and_oversize():
+    lru = BudgetLRU(100)
+    lru.put("big", 1, 101)  # larger than the whole budget: not cached
+    assert lru.get("big") is None
+    lru.put("a", 1, 60)
+    lru.put("b", 2, 60)  # evicts a
+    assert lru.get("a") is None and lru.get("b") == 2
+    assert lru.stats()["bytes"] <= 100
+
+
+def test_lru_update_replaces_bytes():
+    lru = BudgetLRU(100)
+    lru.put("a", 1, 80)
+    lru.put("a", 2, 30)  # replace, not accumulate
+    assert lru.get("a") == 2
+    assert lru.stats()["bytes"] == 30
+    assert lru.stats()["entries"] == 1
+
+
+# ---------------------------------------------------------------------------
+# engine-level isolation: interleaved (base, modulus) groups, warm vs cold
+
+
+@pytest.mark.skipif(not native.available(), reason="no native core")
+def test_native_comb_cache_isolation():
+    m_a, m_b = _odd_mod(768), _odd_mod(768)
+    base_a, base_b = RNG.randrange(2, m_a), RNG.randrange(2, m_b)
+    exps_a = [RNG.getrandbits(768) for _ in range(6)]
+    exps_b = [RNG.getrandbits(768) for _ in range(6)]
+
+    clear_caches()
+    cold_a = native.modexp_shared(base_a, exps_a, m_a)
+    clear_caches()
+    cold_b = native.modexp_shared(base_b, exps_b, m_b)
+
+    clear_caches()
+    warm = [
+        native.modexp_shared(base_a, exps_a, m_a),
+        native.modexp_shared(base_b, exps_b, m_b),
+        native.modexp_shared(base_a, exps_a, m_a),  # hit for A
+        native.modexp_shared(base_b, exps_b, m_b),  # hit for B
+    ]
+    assert warm[0] == warm[2] == cold_a
+    assert warm[1] == warm[3] == cold_b
+    stats = cache_stats()
+    assert stats["hits"] >= 2  # second round served from the cache
+    assert cold_a == [pow(base_a, e, m_a) for e in exps_a]
+    assert cold_b == [pow(base_b, e, m_b) for e in exps_b]
+
+
+def test_device_comb_powers_cache_isolation():
+    from fsdkr_tpu.ops.montgomery import shared_base_modexp
+
+    m_a, m_b = _odd_mod(768), _odd_mod(768)
+    bases_a = [RNG.randrange(2, m_a) for _ in range(2)]
+    bases_b = [RNG.randrange(2, m_b) for _ in range(2)]
+    exps = [[RNG.getrandbits(256) for _ in range(4)] for _ in range(2)]
+
+    clear_caches()
+    cold_a = shared_base_modexp(bases_a, exps, [m_a] * 2, 48)
+    clear_caches()
+    cold_b = shared_base_modexp(bases_b, exps, [m_b] * 2, 48)
+
+    clear_caches()
+    assert shared_base_modexp(bases_a, exps, [m_a] * 2, 48) == cold_a
+    assert shared_base_modexp(bases_b, exps, [m_b] * 2, 48) == cold_b
+    s0 = cache_stats()["hits"]
+    assert shared_base_modexp(bases_a, exps, [m_a] * 2, 48) == cold_a
+    assert shared_base_modexp(bases_b, exps, [m_b] * 2, 48) == cold_b
+    assert cache_stats()["hits"] > s0
+    for bs, m, out in ((bases_a, m_a, cold_a), (bases_b, m_b, cold_b)):
+        for b, es, o in zip(bs, exps, out):
+            assert o == [pow(b, e, m) for e in es]
+
+
+def test_cache_budget_zero_disables(monkeypatch):
+    import fsdkr_tpu.utils.lru as lru_mod
+
+    monkeypatch.setattr(lru_mod, "_GLOBAL", BudgetLRU(0))
+    m = _odd_mod(768)
+    base = RNG.randrange(2, m)
+    exps = [RNG.getrandbits(512) for _ in range(4)]
+    if native.available():
+        assert native.modexp_shared(base, exps, m) == [
+            pow(base, e, m) for e in exps
+        ]
+    assert global_cache().stats()["entries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# collect-level isolation: two committees, interleaved warm collects vs
+# cold-cache collects — verdict-identical, honest and tampered
+
+
+def _run_collect(refreshed, config, mutate=None, collector=0):
+    from fsdkr_tpu.protocol import RefreshMessage
+
+    keys, msgs, dks = refreshed
+    msgs = copy.deepcopy(msgs)
+    if mutate is not None:
+        mutate(msgs)
+    key = keys[collector].clone()
+    try:
+        RefreshMessage.collect(msgs, key, dks[collector], (), config)
+        return None
+    except Exception as e:  # noqa: BLE001 - verdict identity compares classes
+        return type(e).__name__
+
+
+def _tamper(msgs):
+    msgs[1].pdl_proof_vec[0] = dataclasses.replace(
+        msgs[1].pdl_proof_vec[0], s1=msgs[1].pdl_proof_vec[0].s1 + 1
+    )
+
+
+@pytest.mark.heavy
+def test_collect_interleaved_committees(one_refresh_round, test_config):
+    """Interleaved collects of two different committees, warm cache, must
+    match each committee's cold-cache verdicts exactly (honest accept,
+    tampered reject) — no cross-key contamination through the persistent
+    tables."""
+    from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+
+    config = test_config.with_backend("tpu")
+    # committee B is independent of the (session-cached) committee A
+    keygen = getattr(simulate_keygen, "uncached", simulate_keygen)
+    keys_b = keygen(1, 3, test_config)
+    out_b = [RefreshMessage.distribute(k.i, k, 3, config) for k in keys_b]
+    round_b = (keys_b, [m for m, _ in out_b], [dk for _, dk in out_b])
+    round_a = one_refresh_round
+
+    # cold-cache reference verdicts, one committee at a time
+    clear_caches()
+    cold = [
+        _run_collect(round_a, config),
+        _run_collect(round_a, config, mutate=_tamper),
+    ]
+    clear_caches()
+    cold += [
+        _run_collect(round_b, config),
+        _run_collect(round_b, config, mutate=_tamper),
+    ]
+
+    # warm interleaved: A, B, A(tampered), B(tampered), A, B
+    clear_caches()
+    warm = [
+        _run_collect(round_a, config),
+        _run_collect(round_b, config),
+        _run_collect(round_a, config, mutate=_tamper),
+        _run_collect(round_b, config, mutate=_tamper),
+        _run_collect(round_a, config),
+        _run_collect(round_b, config),
+    ]
+    assert warm[0] is None and warm[4] is None  # honest A accepts warm
+    assert warm[1] is None and warm[5] is None  # honest B accepts warm
+    assert warm[0] == warm[4] == cold[0]
+    assert warm[1] == warm[5] == cold[2]
+    assert warm[2] == cold[1]  # tampered A rejects identically
+    assert warm[3] == cold[3]  # tampered B rejects identically
+    assert cold[1] is not None and cold[3] is not None

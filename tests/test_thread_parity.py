@@ -1,0 +1,273 @@
+"""Thread-parity suite for the row-parallel native engines.
+
+Every batch entry point of both native cores (csrc/fsdkr_native.cpp,
+csrc/fsdkr_ec.cpp) must produce BIT-IDENTICAL results at any
+FSDKR_THREADS setting: rows are independent and the thread pool only
+partitions the row range, so `=1` (the historical serial loop) and `=8`
+(forced row pool, exercised even on single-core CI hosts) are compared
+value-for-value — modexp, joint ladder, comb, modmul, EC lincomb/Horner/
+scalar-mul, and Miller-Rabin verdicts — including the error/fallback
+paths (even moduli, oversized rows) and under concurrent Python callers.
+
+scripts/ci.sh runs this file with FSDKR_THREADS=8 forced so the
+concurrent row pool is exercised on every commit, not only on many-core
+bench hosts.
+"""
+
+import random
+
+import pytest
+
+from fsdkr_tpu import native
+from fsdkr_tpu.native import ec as native_ec
+
+RNG = random.Random(0x7157)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no g++?)"
+)
+
+
+def _odd_mod(bits):
+    return RNG.getrandbits(bits) | (1 << (bits - 1)) | 1
+
+
+def _with_threads(monkeypatch, val):
+    monkeypatch.setenv("FSDKR_THREADS", val)
+
+
+def _both_thread_counts(monkeypatch, fn):
+    """Run fn() under FSDKR_THREADS=1 and =8 and return both results."""
+    _with_threads(monkeypatch, "1")
+    assert native.thread_count() == 1
+    serial = fn()
+    _with_threads(monkeypatch, "8")
+    assert native.thread_count() == 8
+    pooled = fn()
+    return serial, pooled
+
+
+# ---------------------------------------------------------------------------
+# bignum core
+
+
+def test_modexp_batch_parity(monkeypatch):
+    mods = [_odd_mod(768) for _ in range(13)]
+    bs = [RNG.getrandbits(768) for _ in mods]
+    es = [RNG.getrandbits(RNG.choice([1, 64, 256, 700])) for _ in mods]
+    serial, pooled = _both_thread_counts(
+        monkeypatch, lambda: native.modexp_batch(bs, es, mods)
+    )
+    assert serial == pooled == [pow(b, e, m) for b, e, m in zip(bs, es, mods)]
+
+
+def test_modexp_batch_fallback_parity(monkeypatch):
+    # an even modulus fails the whole native batch on any thread: both
+    # settings must take the row-wise CPython fallback and agree
+    mods = [_odd_mod(512) for _ in range(7)] + [1 << 512]
+    bs = [RNG.getrandbits(512) for _ in mods]
+    es = [RNG.getrandbits(512) for _ in mods]
+    serial, pooled = _both_thread_counts(
+        monkeypatch, lambda: native.modexp_batch(bs, es, mods)
+    )
+    assert serial == pooled == [pow(b, e, m) for b, e, m in zip(bs, es, mods)]
+
+
+def test_modexp_batch_tiled_parity(monkeypatch):
+    # tiles + row pool together: results must match the untiled serial
+    # loop exactly (tiling only re-buckets L/EL per tile, never values)
+    mods = [_odd_mod(512) for _ in range(21)]
+    bs = [RNG.getrandbits(512) for _ in mods]
+    es = [RNG.getrandbits(384) for _ in mods]
+    monkeypatch.setenv("FSDKR_TILE_ROWS", "4")
+    serial, pooled = _both_thread_counts(
+        monkeypatch, lambda: native.modexp_batch(bs, es, mods)
+    )
+    monkeypatch.setenv("FSDKR_TILE_ROWS", "0")
+    _with_threads(monkeypatch, "1")
+    untiled = native.modexp_batch(bs, es, mods)
+    assert serial == pooled == untiled
+
+
+def test_modexp_shared_parity(monkeypatch):
+    m = _odd_mod(768)
+    base = RNG.randrange(2, m)
+    exps = [0, 1, (1 << 768) - 1] + [RNG.getrandbits(768) for _ in range(10)]
+    for cache in (False, True):
+        serial, pooled = _both_thread_counts(
+            monkeypatch, lambda: native.modexp_shared(base, exps, m, cache=cache)
+        )
+        assert serial == pooled == [pow(base, e, m) for e in exps]
+
+
+def test_multi_modexp_batch_parity(monkeypatch):
+    m_vec = [_odd_mod(768) for _ in range(9)]
+    bases = [tuple(RNG.randrange(1, m) for _ in range(3)) for m in m_vec]
+    exps = [
+        (RNG.getrandbits(768), RNG.getrandbits(256), RNG.getrandbits(64))
+        for _ in m_vec
+    ]
+    serial, pooled = _both_thread_counts(
+        monkeypatch, lambda: native.multi_modexp_batch(bases, exps, m_vec)
+    )
+    want = []
+    for b, e, m in zip(bases, exps, m_vec):
+        acc = 1
+        for b_t, e_t in zip(b, e):
+            acc = acc * pow(b_t, e_t, m) % m
+        want.append(acc)
+    assert serial == pooled == want
+
+
+def test_modmul_batch_parity(monkeypatch):
+    # mixed moduli incl. repeats (constants amortize over runs) and one
+    # even modulus batch exercising the fallback under both settings
+    shared = _odd_mod(768)
+    mods = [shared] * 5 + [_odd_mod(768) for _ in range(6)]
+    a = [RNG.getrandbits(800) for _ in mods]
+    b = [RNG.getrandbits(800) for _ in mods]
+    serial, pooled = _both_thread_counts(
+        monkeypatch, lambda: native.modmul_batch(a, b, mods)
+    )
+    assert serial == pooled == [x * y % m for x, y, m in zip(a, b, mods)]
+    even = mods[:3] + [1 << 700]
+    a2, b2 = a[:4], b[:4]
+    serial, pooled = _both_thread_counts(
+        monkeypatch, lambda: native.modmul_batch(a2, b2, even)
+    )
+    assert serial == pooled == [x * y % m for x, y, m in zip(a2, b2, even)]
+
+
+def test_miller_rabin_parity(monkeypatch):
+    cases = [
+        2**521 - 1,  # prime
+        (2**127 - 1) * (2**89 - 1),  # semiprime
+        561,  # Carmichael
+        _odd_mod(512),
+    ]
+    for n in cases:
+        serial, pooled = _both_thread_counts(
+            monkeypatch, lambda: native.is_probable_prime(n, 16)
+        )
+        # witnesses are CSPRNG-fresh per call, but 16 rounds make the
+        # verdict deterministic in practice for these inputs
+        assert serial == pooled
+
+
+def test_limb_widen_narrow_parity(monkeypatch):
+    import numpy as np
+
+    a16 = np.array(
+        [[RNG.getrandbits(16) for _ in range(64)] for _ in range(64)],
+        dtype=np.uint16,
+    )
+    serial, pooled = _both_thread_counts(
+        monkeypatch, lambda: native.widen_limbs(a16).tolist()
+    )
+    assert serial == pooled == a16.astype(np.uint32).tolist()
+    a32 = a16.astype(np.uint32)
+    serial, pooled = _both_thread_counts(
+        monkeypatch, lambda: native.narrow_limbs(a32).tolist()
+    )
+    assert serial == pooled == a16.tolist()
+    bad = a32.copy()
+    bad[5, 7] |= 1 << 20
+    for val in ("1", "8"):
+        _with_threads(monkeypatch, val)
+        with pytest.raises(ValueError):
+            native.narrow_limbs(bad)
+
+
+# ---------------------------------------------------------------------------
+# EC core
+
+
+@pytest.mark.skipif(not native_ec.available(), reason="no native EC core")
+def test_ec_batch_parity(monkeypatch):
+    from fsdkr_tpu.core.secp256k1 import GENERATOR, N as ORDER
+
+    pts, p = [], GENERATOR
+    for _ in range(11):
+        pts.append((p.x, p.y))
+        p = p + GENERATOR
+    pts.append(None)  # identity row
+    sc = [RNG.randrange(0, ORDER) for _ in pts]
+    serial, pooled = _both_thread_counts(
+        monkeypatch, lambda: native_ec.scalar_mul_batch(pts, sc)
+    )
+    assert serial == pooled
+    serial, pooled = _both_thread_counts(
+        monkeypatch, lambda: native_ec.lincomb2_batch(pts, sc, pts, sc[::-1])
+    )
+    assert serial == pooled
+    commits = pts[:4]
+    idxs = list(range(1, 10))
+    serial, pooled = _both_thread_counts(
+        monkeypatch, lambda: native_ec.horner_batch(commits, idxs)
+    )
+    assert serial == pooled
+
+
+# ---------------------------------------------------------------------------
+# concurrent callers: the row pool must be safe under simultaneous batch
+# calls from multiple Python threads (the tile pipeline does exactly
+# this), including rows that force the error/fallback path
+
+
+def test_concurrent_callers(monkeypatch):
+    from concurrent.futures import ThreadPoolExecutor
+
+    _with_threads(monkeypatch, "8")
+    jobs = []
+    for j in range(6):
+        mods = [_odd_mod(512) for _ in range(5)]
+        if j % 3 == 2:
+            mods[2] = 1 << 512  # even: whole-batch fallback for this job
+        bs = [RNG.getrandbits(512) for _ in mods]
+        es = [RNG.getrandbits(300) for _ in mods]
+        jobs.append((bs, es, mods))
+    with ThreadPoolExecutor(max_workers=4) as ex:
+        futs = [
+            ex.submit(native.modexp_batch, bs, es, mods)
+            for bs, es, mods in jobs
+        ]
+        got = [f.result() for f in futs]
+    for (bs, es, mods), res in zip(jobs, got):
+        assert res == [pow(b, e, m) for b, e, m in zip(bs, es, mods)]
+
+
+def test_planner_thread_parity(monkeypatch):
+    """multi_powm (host engines) end-to-end at both thread settings:
+    comb-routed terms, joint rows, generic loners, negative exponents."""
+    import math
+
+    from fsdkr_tpu.backend.powm import multi_powm
+
+    m = _odd_mod(768)
+    h1, h2 = RNG.randrange(2, m), RNG.randrange(2, m)
+    bases, exps = [], []
+    for _ in range(8):
+        while True:
+            loner = RNG.randrange(2, m)
+            if math.gcd(loner, m) == 1:
+                break
+        bases.append((h1, h2, loner))
+        exps.append(
+            (RNG.getrandbits(256), RNG.getrandbits(512), -RNG.getrandbits(128))
+        )
+    mods = [m] * 8
+
+    def run():
+        return multi_powm(
+            [list(b) for b in bases], [list(e) for e in exps], mods,
+            device=False,
+        )
+
+    serial, pooled = _both_thread_counts(monkeypatch, run)
+    want = []
+    for b, e in zip(bases, exps):
+        acc = 1
+        for b_t, e_t in zip(b, e):
+            acc = acc * pow(b_t, e_t, m) % m
+        want.append(acc)
+    assert serial == pooled == want
