@@ -207,6 +207,18 @@ def _jax_cache_entries() -> int:
         return 0
 
 
+def rlc_fields():
+    """Fold statistics of the cross-proof randomized batch verifier
+    (FSDKR_RLC, fsdkr_tpu.backend.rlc), accumulated since the caller's
+    stats_reset — rlc_groups / rows_folded / fullwidth_ladders /
+    bisect_fallbacks. The battery's A/B step reads fullwidth_ladders ==
+    O(groups), not O(rows), off this field; on an honest transcript
+    bisect_fallbacks must be 0."""
+    from fsdkr_tpu.backend import rlc
+
+    return {"rlc_enabled": rlc.rlc_enabled(), "rlc": rlc.stats()}
+
+
 def roofline_fields(t_warm, stats=None):
     """mfu/gmacs fields for a bench JSON, from tracer stats accumulated
     during the warm run (caller resets the tracer before it), or from an
@@ -281,9 +293,11 @@ def bench_sessions(sessions_count, n, t, bits, m_sec):
 
     t_cold = run()
     log(f"fused collect_sessions cold: {t_cold:.2f}s")
+    from fsdkr_tpu.backend import rlc
     from fsdkr_tpu.utils.trace import get_tracer
 
     get_tracer().reset()
+    rlc.stats_reset()
     t_warm = run()
     total_proofs = proofs_per_session * sessions_count
     log(
@@ -308,6 +322,7 @@ def bench_sessions(sessions_count, n, t, bits, m_sec):
             **({"degraded": os.environ["BENCH_DEGRADED"]}
                if os.environ.get("BENCH_DEGRADED") else {}),
             "mesh": mesh_shape,
+            **rlc_fields(),
             **roofline_fields(t_warm),
         }
     )
@@ -360,9 +375,11 @@ def bench_join(n, t, bits, m_sec, joins):
     RefreshMessage.collect(msgs, keys[0].clone(), dks[0], join_messages, tpu_cfg)
     t_cold = time.time() - t0
     log(f"join collect cold: {t_cold:.2f}s")
+    from fsdkr_tpu.backend import rlc
     from fsdkr_tpu.utils.trace import get_tracer
 
     get_tracer().reset()
+    rlc.stats_reset()
     t0 = time.time()
     RefreshMessage.collect(msgs, keys[1].clone(), dks[1], join_messages, tpu_cfg)
     t_warm = time.time() - t0
@@ -379,6 +396,7 @@ def bench_join(n, t, bits, m_sec, joins):
             "collect_warm_s": round(t_warm, 2),
             "collect_cold_s": round(t_cold, 2),
             "replace_s": round(t_replace, 2),
+            **rlc_fields(),
             "device_ec": tpu_cfg.device_ec,
             "device_powm": tpu_cfg.device_powm,
             "pallas": os.environ.get("FSDKR_PALLAS", "auto"),
@@ -459,10 +477,12 @@ def main():
         f"{cache_after - cache_before} fresh compiles)"
     )
 
+    from fsdkr_tpu.backend import rlc
     from fsdkr_tpu.backend.powm import powm_cache_stats
 
     cache_cold = powm_cache_stats()
     get_tracer().reset()
+    rlc.stats_reset()
     t0 = time.time()
     RefreshMessage.collect(msgs, keys[1].clone(), dks[1], (), tpu_cfg)
     t_tpu = time.time() - t0
@@ -594,6 +614,10 @@ def main():
             "misses_warm": cache_warm["misses"] - cache_cold["misses"],
         },
         "fsdkr_threads": native.thread_count(),
+        # warm-collect fold statistics of the randomized batch verifier
+        # (FSDKR_RLC): fullwidth_ladders must read O(rlc_groups), not
+        # O(rows_folded), and bisect_fallbacks 0 on honest transcripts
+        **rlc_fields(),
     }
     if trace_out:
         result["trace"] = trace_out  # warm-collect per-phase seconds

@@ -650,8 +650,15 @@ int fsdkr_modexp_shared(const u64 *base, const u64 *exps, const u64 *n,
 // Layout: bases rows*k*L, exps rows*k*EL (uniform EL, little-endian),
 // mods/outs rows*L. k <= MAXK; EL capped like the comb (adversarial
 // widths are gated upstream; this is the allocation backstop).
+//
+// k is NOT limited to a handful of terms: the RLC aggregated groups
+// (backend.rlc) submit n-term rows — one 128-384-bit exponent per
+// folded proof row plus the merged shared-base terms — so the per-term
+// window tables live on the heap (k * 2^wbits * L words, ~1 MB per
+// thread at the n=256 ring-Pedersen shape) and MAXK is only the
+// allocation backstop against adversarially huge launches.
 
-static const int MAXK = 8;
+static const int MAXK = 4096;
 
 int fsdkr_multi_modexp_batch(const u64 *bases, const u64 *exps,
                              const u64 *mods, u64 *outs, const int *ebits,
@@ -660,8 +667,8 @@ int fsdkr_multi_modexp_batch(const u64 *bases, const u64 *exps,
       k <= 0 || k > MAXK || wbits < 1 || wbits > 6)
     return -1;
   const int D = 1 << wbits;
-  int W = 0;       // shared chain depth: max window count over terms
-  int Wt[MAXK];    // per-term window counts
+  int W = 0;                // shared chain depth: max window count over terms
+  std::vector<int> Wt(k);   // per-term window counts (k is runtime-sized)
   for (int t = 0; t < k; t++) {
     if (ebits[t] <= 0 || ebits[t] > EL * 64)
       return -1;

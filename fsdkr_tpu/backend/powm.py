@@ -386,12 +386,51 @@ def batch_base_inv(values, moduli):
     return out
 
 
+# Device joint-ladder term cap: an n-term row (the FSDKR_RLC aggregated
+# groups reach 2n+1 terms) is split into sub-rows of at most this many
+# terms before a device launch — the CIOS/RNS kernels unroll one table
+# lookup per term per window inside the traced loop body, so an
+# unbounded term count would compile a fresh, enormous kernel variant
+# per group shape. Sub-rows share the launch (same bucket) and their
+# partial products recombine with host bigint mulmods; the repeated
+# short squaring chains cost ~(chunks-1)*chain_bits extra squarings,
+# noise at the 128-384-bit aggregate-chain widths. The native C++
+# engine takes n-term rows directly (no cap below 4096 terms).
+_DEVICE_MAX_TERMS = int(_os.environ.get("FSDKR_DEVICE_MAX_TERMS", "16"))
+
+
 def _joint_rows(bases_rows, exps_rows, moduli, device: bool) -> List[int]:
     """Straus joint ladders for rows of >= 2 per-row-base terms, bucketed
-    by (term count, modulus limb class) per launch. Exponents must be
-    non-negative (negatives are folded by multi_powm)."""
+    by (term count, modulus limb class) per launch. Rows may carry
+    different term counts (variable arity: the RLC aggregated groups mix
+    2-term merged-base rows with n-term per-row-base rows); each arity
+    shape is its own launch bucket. Exponents must be non-negative
+    (negatives are folded by multi_powm)."""
     from ..ops.limbs import bucket_exp_bits, limbs_for_bits
 
+    cap = _DEVICE_MAX_TERMS if device else 0
+    if cap and any(len(bs) > cap for bs in bases_rows):
+        # split oversized rows into <= cap-term sub-rows; evaluate the
+        # whole (split + small) row set in one recursion, then fold each
+        # original row's partials back with host mulmods (C-speed bigint)
+        sub_b: List = []
+        sub_e: List = []
+        sub_m: List = []
+        owners: List[List[int]] = []
+        for i, (bs, es, m) in enumerate(zip(bases_rows, exps_rows, moduli)):
+            slots = []
+            for lo in range(0, len(bs), cap) if len(bs) > cap else [0]:
+                hi = min(lo + cap, len(bs)) if len(bs) > cap else len(bs)
+                slots.append(len(sub_m))
+                sub_b.append(tuple(bs[lo:hi]))
+                sub_e.append(tuple(es[lo:hi]))
+                sub_m.append(m)
+            owners.append(slots)
+        res = _joint_rows(sub_b, sub_e, sub_m, device)
+        return [
+            _prod_mod([res[s] for s in slots], m)
+            for slots, m in zip(owners, moduli)
+        ]
     out: List = [None] * len(moduli)
     # bucket by (term count, modulus limb class, per-term width classes):
     # a launch's shared chain is as deep as its widest term and each term

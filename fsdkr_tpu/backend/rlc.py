@@ -1,0 +1,159 @@
+"""Cross-proof randomized batch verification for the modexp families.
+
+Bellare-Garay-Rabin small-exponent random linear combination (RLC):
+verification rows that share a modulus — all ring-Pedersen rows of one
+proof (mod N), all correct-key rounds of one proof (mod N), the n PDL
+rows addressed to one receiver (mod N~ and mod N^2) — fold into ONE
+combined equation per group,
+
+    prod_i (lhs_i / rhs_i)^{rho_i} == 1  (mod M),
+
+with secret fresh rho_i in [1, 2^128) drawn from the OS CSPRNG per
+verification. A group containing at least one failing row passes with
+probability at most 2^-128 over the verifier's own coins (see
+SECURITY.md for the bound's fine print in groups of unknown order).
+Division never happens: each family's fold moves terms so both sides
+are products of non-negative powers and the check is an equality of two
+computed group elements.
+
+Where the per-row check costs one full-width (2048/4096-bit) squaring
+chain per row, the folded check costs O(1) full-width chains per GROUP
+(the bases shared across rows — h1, h2, T, g = N+1 — merge their
+exponents into one full-width term) plus one short aggregated chain
+over the per-row bases, whose exponents are only 128-384 bits wide.
+
+Blame semantics: a failing combined check triggers recursive bisection
+(`bisect_rows`) — subsets are re-checked with fresh rho, and leaves
+fall back to the exact per-row equation — so a row is only ever marked
+INVALID through its exact per-row check (false blame is impossible:
+all-valid subsets pass with probability 1, products of true
+equations). The converse inference — a passing subset is all-valid —
+is the probabilistic one: it fails only with the group soundness
+error, i.e. 2^-128 per check, DEGRADED for a row whose equation
+residue has small order in an adversary-chosen modulus (SECURITY.md).
+Within that bound, per-row verdicts (and the reference's
+identifiable-abort attribution, `/root/reference/src/error.rs`) match
+the per-row path.
+
+`FSDKR_RLC` gates the whole mechanism (default on); `=0` reverts every
+caller to the per-row column/joint path for A/B isolation.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import threading
+from typing import Callable, Dict, List, Sequence
+
+__all__ = [
+    "RLC_BITS",
+    "rlc_enabled",
+    "sample_rhos",
+    "bisect_rows",
+    "stats",
+    "stats_reset",
+    "count",
+]
+
+RLC_BITS = 128
+
+
+def rlc_enabled() -> bool:
+    """FSDKR_RLC gates cross-proof randomized batch verification: =0
+    reverts the verifier to the per-row column/joint path. Read at call
+    time so the bench battery and the CI legs can toggle it per step."""
+    return os.environ.get("FSDKR_RLC", "1").lower() not in (
+        "0", "off", "false", "no",
+    )
+
+
+def sample_rhos(count: int) -> List[int]:
+    """count secret coefficients rho_i in [1, 2^128), fresh from the OS
+    CSPRNG. Never cached, never persisted, never part of any cache key
+    (SECURITY.md): rho only ever flows into exponent staging buffers,
+    which carry the standard wipe discipline."""
+    top = (1 << RLC_BITS) - 1
+    return [1 + secrets.randbelow(top) for _ in range(count)]
+
+
+# ---------------------------------------------------------------------------
+# Fold statistics (emitted in the bench JSON as the `rlc` field): how many
+# groups folded, how many per-row equations they absorbed, how many
+# full-width ladders the folded plan still launches (the O(1)-per-group
+# count the fold exists to achieve), and how many groups fell back to
+# bisection. Process-wide with a lock: collect() fans launches out over
+# the pipeline thread pool.
+
+_LOCK = threading.Lock()
+_STATS: Dict[str, int] = {}
+
+
+def _zero() -> Dict[str, int]:
+    return {
+        "rlc_groups": 0,
+        "rows_folded": 0,
+        "fullwidth_ladders": 0,
+        "bisect_fallbacks": 0,
+    }
+
+
+_STATS = _zero()
+
+
+def count(name: str, n: int = 1) -> None:
+    with _LOCK:
+        _STATS[name] = _STATS.get(name, 0) + n
+
+
+def stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_STATS)
+
+
+def stats_reset() -> None:
+    global _STATS
+    with _LOCK:
+        _STATS = _zero()
+
+
+# ---------------------------------------------------------------------------
+
+
+def bisect_rows(
+    indices: Sequence[int],
+    combined_check: Callable[[List[int]], bool],
+    row_check: Callable[[int], bool],
+    leaf: int = 2,
+) -> Dict[int, bool]:
+    """Per-row verdicts for a group whose combined check failed.
+
+    Recursively halves the row set: a subset passing `combined_check`
+    (fresh rho each call) is marked all-valid, while a failing subset
+    splits further until `leaf` rows remain, which are decided by the
+    exact `row_check`. Rows are therefore only marked INVALID through
+    the exact check — an all-valid subset passes with probability 1
+    (products of true equations), so false blame is impossible. The
+    all-valid marking of a PASSING subset is the probabilistic
+    inference: it inherits the combined check's soundness error (see
+    the module docstring for the bound and its small-order caveat).
+    A group with b bad rows costs O(b * log(n)) combined sub-checks
+    plus O(b * leaf) exact row checks, against the n exact checks of a
+    flat re-verify.
+    """
+    out: Dict[int, bool] = {}
+    stack: List[List[int]] = [list(indices)]
+    while stack:
+        rows = stack.pop()
+        if len(rows) <= leaf:
+            for i in rows:
+                out[i] = bool(row_check(i))
+            continue
+        mid = (len(rows) + 1) // 2
+        for half in (rows[:mid], rows[mid:]):
+            if combined_check(half):
+                for i in half:
+                    out[i] = True
+            else:
+                stack.append(half)
+    return out

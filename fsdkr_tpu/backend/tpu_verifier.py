@@ -40,10 +40,9 @@ from ..proofs.ring_pedersen import RingPedersenProof
 from ..utils.trace import phase
 from .batch_verifier import BatchVerifier, HostBatchVerifier
 
-# honest-value width caps for wire integers (domain gates in the
-# prepare/verify methods): q^3 is the slack-range bound of the GG-style
-# sigma protocols (`src/range_proofs.rs:125`)
-_Q3 = CURVE_ORDER**3
+# (wire-integer width caps — the q^3 slack-range bound of the GG-style
+# sigma protocols, `src/range_proofs.rs:125` — live in the proof
+# modules' domain_gate helpers, shared by the RLC and column paths)
 
 
 def _modexp(bases, exps, moduli) -> List[int]:
@@ -96,27 +95,14 @@ class TpuBatchVerifier(BatchVerifier):
         row through the column-exact per-row check in _pdl_finish, so
         joint and column verdicts are bit-identical.
 
-        Exponent-position proof fields (s1, s3) are attacker-chosen wire
-        integers: a negative value would crash the limb encoder mid-batch
-        (no identifiable abort) rather than fail one row, and an
-        oversized one would inflate the whole fused launch's exponent
-        width (bucket_exp_bits sizes a column by its max) — a one-row
-        DoS. Out-of-domain rows are staged with zeros and force-failed
-        in _pdl_finish; base-position fields reduce mod n on staging.
-        Width caps: honest s1 = e*x + alpha < 2q^3 (832 bits of slack
-        used), s3 = e*rho + gamma < 2q^3 * N_tilde. Transcript-position
-        fields (z, u2, u3, ciphertext) must also be gated BEFORE hashing:
-        chain_int rejects negatives with a raw ValueError."""
-        row_ok = [
-            p.z >= 0
-            and p.u2 >= 0
-            and p.u3 >= 0
-            and st.ciphertext >= 0
-            and 0 <= p.s1 <= 2 * _Q3
-            and 0 <= p.s3
-            and p.s3.bit_length() <= st.N_tilde.bit_length() + 832
-            for p, st in items
-        ]
+        Out-of-domain rows (PDLwSlackProof.domain_gate — attacker-chosen
+        wire integers must not crash the limb encoder or inflate the
+        fused launch width; see the gate's docstring) are staged with
+        zeros and force-failed in _pdl_finish; base-position fields
+        reduce mod n on staging. Transcript-position fields must be
+        gated BEFORE hashing: chain_int rejects negatives with a raw
+        ValueError."""
+        row_ok = [PDLwSlackProof.domain_gate(p, st) for p, st in items]
         with phase("pdl.challenge", items=len(items)):
             e_vec = [
                 PDLwSlackProof._challenge(
@@ -230,13 +216,236 @@ class TpuBatchVerifier(BatchVerifier):
             out.append(None if (ok1 and ok2 and ok3) else (ok1, ok2, ok3))
         return out
 
+    # -- FSDKR_RLC: cross-proof randomized batch verification ----------
+    def _pdl_rlc_prepare(self, items):
+        """Gate rows, recompute challenges, and fold the live rows into
+        per-receiver-modulus RLC groups (backend.rlc). Rows addressed to
+        one receiver share that receiver's (h1, h2, N~) statement and
+        Paillier key, so a collect() batch folds into one mod-N~ and one
+        mod-n^2 group per receiver slot — each costing O(1) full-width
+        ladders instead of one per row.
+
+        Returns (cols, state): cols is ONE joint multi-exponentiation
+        column holding every group's phase-1 rows (eq3's merged-h1/h2
+        ladder + per-row aggregate; eq2's s2 aggregate + u2/c
+        aggregate), which powm_columns pools with any co-launched
+        family — verify_pairs fuses it with the range columns. Phase 2
+        (raising each eq2 s2-aggregate to n, the group's one remaining
+        full-width ladder) runs in _pdl_rlc_finish after phase 1 lands.
+        Domain gating runs BEFORE aggregation: an out-of-domain row
+        never enters a fold (it would poison its group's verdict and
+        force a needless bisection) and is force-failed in finish."""
+        from . import rlc
+
+        row_ok = [PDLwSlackProof.domain_gate(p, st) for p, st in items]
+        with phase("pdl.challenge", items=len(items)):
+            e_vec = [
+                PDLwSlackProof._challenge(
+                    st, p.z, p.u1, p.u2, p.u3, self.config.hash_alg
+                )
+                if ok
+                else 0
+                for (p, st), ok in zip(items, row_ok)
+            ]
+        nt_groups: Dict[tuple, List[int]] = {}
+        nn_groups: Dict[tuple, List[int]] = {}
+        for i, ((p, st), ok) in enumerate(zip(items, row_ok)):
+            if not ok:
+                continue
+            nt_groups.setdefault((st.h1, st.h2, st.N_tilde), []).append(i)
+            nn_groups.setdefault((st.ek.n, st.ek.nn), []).append(i)
+
+        mb: list = []
+        me: list = []
+        mm: list = []
+        nt_plan = []  # (row indices, lhs position, rhs position)
+        for (h1, h2, nt), idxs in nt_groups.items():
+            rho = rlc.sample_rhos(len(idxs))
+            rows = [
+                (items[i][0].z, items[i][0].u3, e_vec[i],
+                 items[i][0].s1, items[i][0].s3)
+                for i in idxs
+            ]
+            lhs, rhs = PDLwSlackProof.rlc_fold_nt(h1, h2, nt, rows, rho)
+            nt_plan.append((idxs, len(mm), len(mm) + 1))
+            for b, e, m in (lhs, rhs):
+                mb.append(b)
+                me.append(e)
+                mm.append(m)
+        nn_plan = []  # (row indices, n, nn, gs1, s2 position, commit position)
+        for (n, nn), idxs in nn_groups.items():
+            rho = rlc.sample_rhos(len(idxs))
+            rows = [
+                (items[i][0].u2, items[i][1].ciphertext, e_vec[i],
+                 items[i][0].s1, items[i][0].s2)
+                for i in idxs
+            ]
+            s2_row, commit_row, gs1 = PDLwSlackProof.rlc_fold_nn(
+                n, nn, rows, rho
+            )
+            nn_plan.append((idxs, n, nn, gs1, len(mm), len(mm) + 1))
+            for b, e, m in (s2_row, commit_row):
+                mb.append(b)
+                me.append(e)
+                mm.append(m)
+        rlc.count("rlc_groups", len(nt_plan) + len(nn_plan))
+        rlc.count(
+            "rows_folded",
+            sum(len(g[0]) for g in nt_plan) + sum(len(g[0]) for g in nn_plan),
+        )
+        # eq3's merged h1/h2 2-term ladder + eq2's phase-2 A^n: one
+        # full-width squaring chain per group, down from one per row
+        rlc.count("fullwidth_ladders", len(nt_plan) + len(nn_plan))
+        return ((mb, me, mm),), (e_vec, row_ok, nt_plan, nn_plan)
+
+    def _pdl_eq3_exact(self, items, e_vec, i) -> bool:
+        """Column-form mod-N~ equality for exactly row i (bisection
+        leaf; same residues the column path compares)."""
+        from ..core import intops
+
+        p, st = items[i]
+        nt = st.N_tilde
+        lhs = p.u3 % nt * intops.mod_pow(p.z % nt, e_vec[i], nt) % nt
+        rhs = (
+            intops.mod_pow(st.h1 % nt, p.s1, nt)
+            * intops.mod_pow(st.h2 % nt, p.s3, nt)
+            % nt
+        )
+        return lhs == rhs
+
+    def _pdl_eq2_exact(self, items, e_vec, i) -> bool:
+        """Column-form mod-n^2 equality for exactly row i."""
+        from ..core import intops
+
+        p, st = items[i]
+        n, nn = st.ek.n, st.ek.nn
+        lhs = (
+            p.u2 % nn
+            * intops.mod_pow(st.ciphertext % nn, e_vec[i], nn)
+            % nn
+        )
+        gs1 = (1 + (p.s1 % n) * n) % nn
+        rhs = gs1 * intops.mod_pow(p.s2 % nn, n, nn) % nn
+        return lhs == rhs
+
+    def _pdl_rlc_finish(self, items, state, results, u1_vec=None):
+        """Compare each group's folded equation, bisect failing groups
+        down to exact per-row verdicts (backend.rlc.bisect_rows), and
+        assemble the same (u1, u2, u3) triples as _pdl_finish."""
+        from ..core import intops
+        from . import rlc
+        from .powm import multi_powm
+
+        e_vec, row_ok, nt_plan, nn_plan = state
+        multi_res = results[0]
+        ok2_vec = [False] * len(items)
+        ok3_vec = [False] * len(items)
+
+        with phase("pdl.rlc_eq3", items=sum(len(g[0]) for g in nt_plan)):
+            for idxs, lhs_pos, rhs_pos in nt_plan:
+                if multi_res[lhs_pos] == multi_res[rhs_pos]:
+                    for i in idxs:
+                        ok3_vec[i] = True
+                    continue
+                rlc.count("bisect_fallbacks")
+                h1, h2, nt = (
+                    items[idxs[0]][1].h1,
+                    items[idxs[0]][1].h2,
+                    items[idxs[0]][1].N_tilde,
+                )
+
+                def check(sub, h1=h1, h2=h2, nt=nt):
+                    rho = rlc.sample_rhos(len(sub))
+                    rows = [
+                        (items[i][0].z, items[i][0].u3, e_vec[i],
+                         items[i][0].s1, items[i][0].s3)
+                        for i in sub
+                    ]
+                    lhs, rhs = PDLwSlackProof.rlc_fold_nt(
+                        h1, h2, nt, rows, rho
+                    )
+                    va, vb = multi_powm(
+                        [lhs[0], rhs[0]], [lhs[1], rhs[1]], [nt, nt],
+                        device=False,
+                    )
+                    return va == vb
+
+                verdicts = rlc.bisect_rows(
+                    idxs, check,
+                    lambda i: self._pdl_eq3_exact(items, e_vec, i),
+                )
+                for i, v in verdicts.items():
+                    ok3_vec[i] = v
+
+        with phase("pdl.rlc_eq2", items=sum(len(g[0]) for g in nn_plan)):
+            # phase 2: every group's s2-aggregate to the n-th power in
+            # one fused generic launch (the O(1)-per-group ladder)
+            a_pow = _modexp(
+                [multi_res[g[4]] for g in nn_plan],
+                [g[1] for g in nn_plan],
+                [g[2] for g in nn_plan],
+            )
+            for (idxs, n, nn, gs1, _s2_pos, commit_pos), ap in zip(
+                nn_plan, a_pow
+            ):
+                if multi_res[commit_pos] == gs1 * ap % nn:
+                    for i in idxs:
+                        ok2_vec[i] = True
+                    continue
+                rlc.count("bisect_fallbacks")
+
+                def check(sub, n=n, nn=nn):
+                    rho = rlc.sample_rhos(len(sub))
+                    rows = [
+                        (items[i][0].u2, items[i][1].ciphertext, e_vec[i],
+                         items[i][0].s1, items[i][0].s2)
+                        for i in sub
+                    ]
+                    s2_row, commit_row, g1 = PDLwSlackProof.rlc_fold_nn(
+                        n, nn, rows, rho
+                    )
+                    av, cv = multi_powm(
+                        [s2_row[0], commit_row[0]],
+                        [s2_row[1], commit_row[1]],
+                        [nn, nn],
+                        device=False,
+                    )
+                    return cv == g1 * intops.mod_pow(av, n, nn) % nn
+
+                verdicts = rlc.bisect_rows(
+                    idxs, check,
+                    lambda i: self._pdl_eq2_exact(items, e_vec, i),
+                )
+                for i, v in verdicts.items():
+                    ok2_vec[i] = v
+
+        with phase("pdl.ec_u1", items=len(items)):
+            ok1_vec = (
+                u1_vec if u1_vec is not None
+                else self._pdl_u1_batch(items, e_vec)
+            )
+
+        out = []
+        for idx in range(len(items)):
+            ok1 = ok1_vec[idx] and row_ok[idx]
+            ok2 = ok2_vec[idx]
+            ok3 = ok3_vec[idx]
+            out.append(None if (ok1 and ok2 and ok3) else (ok1, ok2, ok3))
+        return out
+
     def verify_pdl(self, items):
         if not items:
             return []
         from ..utils.pipeline import submit_bg
         from .powm import multiexp_enabled, powm_columns
+        from .rlc import rlc_enabled
 
-        cols, state = self._pdl_prepare(items, joint=multiexp_enabled())
+        if rlc_enabled():
+            cols, state = self._pdl_rlc_prepare(items)
+            finish = self._pdl_rlc_finish
+        else:
+            cols, state = self._pdl_prepare(items, joint=multiexp_enabled())
+            finish = self._pdl_finish
         # the EC u1 column needs only (items, e_vec), both fixed before
         # any launch: run it on a background thread so the host EC work
         # hides behind the modexp columns' engine time
@@ -244,7 +453,7 @@ class TpuBatchVerifier(BatchVerifier):
         u1_fut = submit_bg(lambda: self._pdl_u1_batch(items, e_vec))
         with phase("pdl.modexp_columns", items=len(cols) * len(items)):
             results = powm_columns(_modexp, *cols)
-        return self._pdl_finish(
+        return finish(
             items, state, results,
             u1_vec=u1_fut.result() if u1_fut is not None else None,
         )
@@ -349,12 +558,13 @@ class TpuBatchVerifier(BatchVerifier):
         """Return (the family's modexp columns, carry state for
         _range_finish). Column order matches _range_finish.
 
-        Same out-of-domain gating as _pdl_prepare: exponent-position wire
-        fields (s1, s2, e) must be in their honest domains or the row is
-        staged with zeros and force-failed — never crash or inflate the
-        batch. s1's q^3 slack bound (`src/range_proofs.rs:125`) is
-        enforced HERE, pre-launch. Transcript fields (z, cipher, s) are
-        gated non-negative for chain_int.
+        Same out-of-domain gating as _pdl_prepare, via
+        AliceProof.domain_gate: exponent-position wire fields (s1, s2,
+        e) must be in their honest domains or the row is staged with
+        zeros and force-failed — never crash or inflate the batch.
+        s1's q^3 slack bound (`src/range_proofs.rs:125`) is enforced
+        HERE, pre-launch. Transcript fields (z, cipher, s) are gated
+        non-negative for chain_int.
 
         With joint=True (FSDKR_MULTIEXP) the verifier computes the
         reference's own equation shapes directly — w = h1^s1 h2^s2
@@ -369,13 +579,7 @@ class TpuBatchVerifier(BatchVerifier):
         nn_mod = [ek.nn for _, _, ek, _ in items]
         nt_mod = [dlog.N for _, _, _, dlog in items]
         row_ok = [
-            0 <= p.s1 <= _Q3
-            and 0 <= p.s2
-            and p.s2.bit_length() <= dlog.N.bit_length() + 832
-            and 0 <= p.e < (1 << 256)
-            and p.z >= 0
-            and p.s >= 0
-            and c >= 0
+            alice_range.AliceProof.domain_gate(p, c, dlog)
             for p, c, _, dlog in items
         ]
         e_vec = [
@@ -510,9 +714,21 @@ class TpuBatchVerifier(BatchVerifier):
             return super().verify_pairs(pdl_items, range_items)
         from ..utils.pipeline import submit_bg
         from .powm import multiexp_enabled, powm_columns
+        from .rlc import rlc_enabled
 
         joint = multiexp_enabled()
-        pcols, state = self._pdl_prepare(pdl_items, joint=joint)
+        if rlc_enabled():
+            # PDL folds into per-receiver RLC groups (O(1) full-width
+            # ladders per group); the range family cannot fold — its
+            # Fiat-Shamir challenge binds the reconstructed per-row u/w
+            # values (see proofs.alice_range) — so its columns ride the
+            # joint/column path and share phase 1's fused launch set
+            # with the RLC aggregate rows.
+            pcols, state = self._pdl_rlc_prepare(pdl_items)
+            pdl_finish = self._pdl_rlc_finish
+        else:
+            pcols, state = self._pdl_prepare(pdl_items, joint=joint)
+            pdl_finish = self._pdl_finish
         rcols, rmods = self._range_prepare(range_items, joint=joint)
         # overlap the host EC u1 column with the fused modexp launch set
         # (see verify_pdl)
@@ -522,7 +738,7 @@ class TpuBatchVerifier(BatchVerifier):
         with phase("pairs.modexp_columns", items=n_rows):
             results = powm_columns(_modexp, *pcols, *rcols)
         return (
-            self._pdl_finish(
+            pdl_finish(
                 pdl_items, state, results[: len(pcols)],
                 u1_vec=u1_fut.result() if u1_fut is not None else None,
             ),
@@ -530,30 +746,38 @@ class TpuBatchVerifier(BatchVerifier):
         )
 
     # ------------------------------------------------------------------
+    def _ring_pedersen_gate(self, proof, st, m_security) -> bool:
+        """The statement modulus and the proof vectors are wire data: an
+        even/tiny N crashes the Montgomery context, a negative A_i/Z_i
+        crashes the limb encoder or the transcript, and oversized values
+        inflate the launch — gate the row instead (honest: A_i < N,
+        Z_i < phi < N). Must run BEFORE aggregation (FSDKR_RLC) or
+        staging (column path)."""
+        n_cap = self.config.paillier_bits + 64
+        return (
+            len(proof.A) == m_security
+            and len(proof.Z) == m_security
+            and st.N > 2
+            and st.N % 2 == 1
+            and st.N.bit_length() <= n_cap
+            and 0 <= st.S < st.N
+            and 0 <= st.T < st.N
+            and all(0 <= z < st.N for z in proof.Z)
+            and all(0 <= a < st.N for a in proof.A)
+        )
+
     def verify_ring_pedersen(self, items, m_security):
         if not items:
             return []
+        from .rlc import rlc_enabled
+
+        if rlc_enabled():
+            return self._ring_pedersen_rlc(items, m_security)
         bases, exps, moduli, rhs_a, rhs_s = [], [], [], [], []
         shapes_ok = []
-        n_cap = self.config.paillier_bits + 64
         with phase("ringped.challenge", items=len(items)):
             for proof, st in items:
-                # the statement modulus and the proof vectors are wire
-                # data: an even/tiny N crashes the Montgomery context, a
-                # negative A_i/Z_i crashes the limb encoder or the
-                # transcript, and oversized values inflate the launch —
-                # gate the row instead (honest: A_i < N, Z_i < phi < N)
-                ok = (
-                    len(proof.A) == m_security
-                    and len(proof.Z) == m_security
-                    and st.N > 2
-                    and st.N % 2 == 1
-                    and st.N.bit_length() <= n_cap
-                    and 0 <= st.S < st.N
-                    and 0 <= st.T < st.N
-                    and all(0 <= z < st.N for z in proof.Z)
-                    and all(0 <= a < st.N for a in proof.A)
-                )
+                ok = self._ring_pedersen_gate(proof, st, m_security)
                 shapes_ok.append(ok)
                 if not ok:
                     continue
@@ -583,29 +807,117 @@ class TpuBatchVerifier(BatchVerifier):
             out.append(good)
         return out
 
+    def _ring_pedersen_rlc(self, items, m_security):
+        """FSDKR_RLC path: each proof's M binary-challenge rows — all
+        sharing (T, S, N) — fold into one RLC group
+        (RingPedersenProof.rlc_fold): ONE full-width T-ladder plus one
+        short M+1-term aggregated chain, instead of M full-width comb
+        rows. A failing group bisects to exact per-row verdicts."""
+        from ..core import intops
+        from . import rlc
+        from .powm import multi_powm, powm_columns
+
+        shapes_ok = []
+        plan = []  # (proof, st, bits, rho, position)
+        lhs_b, lhs_e, lhs_m = [], [], []
+        mb, me, mm = [], [], []
+        with phase("ringped.challenge", items=len(items)):
+            for proof, st in items:
+                ok = self._ring_pedersen_gate(proof, st, m_security)
+                shapes_ok.append(ok)
+                if not ok:
+                    continue
+                e = RingPedersenProof._challenge(proof.A, self.config.hash_alg)
+                bits = challenge_bits(e, m_security, self.config.hash_alg)
+                rho = rlc.sample_rhos(m_security)
+                lhs, rhs = RingPedersenProof.rlc_fold(st, proof, bits, rho)
+                plan.append((proof, st, bits, len(mm)))
+                lhs_b.append(lhs[0][0])
+                lhs_e.append(lhs[1][0])
+                lhs_m.append(lhs[2])
+                mb.append(rhs[0])
+                me.append(rhs[1])
+                mm.append(rhs[2])
+        if not plan:
+            return [False] * len(items)
+        rlc.count("rlc_groups", len(plan))
+        rlc.count("rows_folded", len(plan) * m_security)
+        rlc.count("fullwidth_ladders", len(plan))
+
+        with phase("ringped.modexp", items=len(plan) * (m_security + 2)):
+            lhs_vals, rhs_vals = powm_columns(
+                _modexp, (lhs_b, lhs_e, lhs_m), (mb, me, mm)
+            )
+
+        out = []
+        k = 0
+        for ok in shapes_ok:
+            if not ok:
+                out.append(False)
+                continue
+            proof, st, bits, pos = plan[k]
+            k += 1
+            if lhs_vals[k - 1] == rhs_vals[pos]:
+                out.append(True)
+                continue
+            rlc.count("bisect_fallbacks")
+
+            def check(sub, proof=proof, st=st, bits=bits):
+                rho = rlc.sample_rhos(len(sub))
+                e_merged = sum(r * proof.Z[i] for r, i in zip(rho, sub))
+                e_s = sum(r for r, i in zip(rho, sub) if bits[i])
+                lhs = intops.mod_pow(st.T % st.N, e_merged, st.N)
+                (rhs,) = multi_powm(
+                    [tuple(proof.A[i] for i in sub) + (st.S,)],
+                    [tuple(rho) + (e_s,)],
+                    [st.N],
+                    device=False,
+                )
+                return lhs == rhs
+
+            def row_check(i, proof=proof, st=st, bits=bits):
+                return (
+                    intops.mod_pow(st.T % st.N, proof.Z[i], st.N)
+                    == proof.A[i] * (st.S if bits[i] else 1) % st.N
+                )
+
+            verdicts = rlc.bisect_rows(range(m_security), check, row_check)
+            out.append(all(verdicts[i] for i in range(m_security)))
+        return out
+
     # ------------------------------------------------------------------
+    def _correct_key_gate(self, proof, ek, rounds) -> bool:
+        """Wire-ek gate (parity / small-factor / width cap), applied
+        BEFORE aggregation or staging."""
+        import math
+
+        n = ek.n
+        n_cap = self.config.paillier_bits + 64
+        return (
+            len(proof.sigma_vec) == rounds
+            and n > 0
+            and n % 2 == 1
+            and n.bit_length() <= n_cap
+            and math.gcd(n, correct_key._PRIMORIAL) == 1
+            and all(0 < s < n for s in proof.sigma_vec)
+        )
+
     def verify_correct_key(self, items, rounds):
         if not items:
             return []
-        import math
+        from .rlc import rlc_enabled
 
+        if rlc_enabled():
+            return self._correct_key_rlc(items, rounds)
         bases, exps, moduli, want = [], [], [], []
         gates = []
-        n_cap = self.config.paillier_bits + 64  # wire ek: cap the launch width
         with phase("correct_key.rho_derive", items=len(items)):
             for proof, ek in items:
-                n = ek.n
-                gate = (
-                    len(proof.sigma_vec) == rounds
-                    and n > 0
-                    and n % 2 == 1
-                    and n.bit_length() <= n_cap
-                    and math.gcd(n, correct_key._PRIMORIAL) == 1
-                    and all(0 < s < n for s in proof.sigma_vec)
-                )
+                gate = self._correct_key_gate(proof, ek, rounds)
                 gates.append(gate)
                 if not gate:
                     continue
+                n = ek.n
                 for i, sigma in enumerate(proof.sigma_vec):
                     bases.append(sigma)
                     exps.append(n)
@@ -629,6 +941,90 @@ class TpuBatchVerifier(BatchVerifier):
             good = all(got[row + i] == want[row + i] for i in range(rounds))
             row += rounds
             out.append(good)
+        return out
+
+    def _correct_key_rlc(self, items, rounds):
+        """FSDKR_RLC path: each proof's `rounds` checks sigma_i^N ==
+        rho_i (mod N) fold into (prod sigma_i^{rho_i})^N == prod
+        rho_i^{rho_i} (NiCorrectKeyProof.rlc_fold): two short aggregated
+        chains in phase 1, then ONE full-width ^N ladder per proof in a
+        fused phase-2 launch — down from `rounds` full-width ladders."""
+        from ..core import intops
+        from . import rlc
+        from .powm import multi_powm, powm_columns
+
+        gates = []
+        plan = []  # (sigma_vec, want, n, sigma position, target position)
+        mb, me, mm = [], [], []
+        with phase("correct_key.rho_derive", items=len(items)):
+            for proof, ek in items:
+                gate = self._correct_key_gate(proof, ek, rounds)
+                gates.append(gate)
+                if not gate:
+                    continue
+                n = ek.n
+                want = [
+                    correct_key._derive_rho(
+                        n, correct_key.SALT_STRING, i, self.config.hash_alg
+                    )
+                    for i in range(rounds)
+                ]
+                rho = rlc.sample_rhos(rounds)
+                sig_row, tgt_row = correct_key.NiCorrectKeyProof.rlc_fold(
+                    proof.sigma_vec, want, n, rho
+                )
+                plan.append((proof.sigma_vec, want, n, len(mm), len(mm) + 1))
+                for b, e, m in (sig_row, tgt_row):
+                    mb.append(b)
+                    me.append(e)
+                    mm.append(m)
+        if not plan:
+            return [False] * len(items)
+        rlc.count("rlc_groups", len(plan))
+        rlc.count("rows_folded", len(plan) * rounds)
+        rlc.count("fullwidth_ladders", len(plan))
+
+        with phase("correct_key.modexp", items=len(plan) * (rounds + 1)):
+            (multi_res,) = powm_columns(_modexp, (mb, me, mm))
+            # phase 2: every aggregate to the N-th power, one fused launch
+            a_pow = _modexp(
+                [multi_res[g[3]] for g in plan],
+                [g[2] for g in plan],
+                [g[2] for g in plan],
+            )
+
+        out = []
+        k = 0
+        for gate in gates:
+            if not gate:
+                out.append(False)
+                continue
+            sigma_vec, want, n, _sig_pos, tgt_pos = plan[k]
+            ap = a_pow[k]
+            k += 1
+            if ap == multi_res[tgt_pos]:
+                out.append(True)
+                continue
+            rlc.count("bisect_fallbacks")
+
+            def check(sub, sigma_vec=sigma_vec, want=want, n=n):
+                rho = rlc.sample_rhos(len(sub))
+                sv, wv = multi_powm(
+                    [
+                        tuple(sigma_vec[i] for i in sub),
+                        tuple(want[i] for i in sub),
+                    ],
+                    [tuple(rho), tuple(rho)],
+                    [n, n],
+                    device=False,
+                )
+                return intops.mod_pow(sv, n, n) == wv
+
+            def row_check(i, sigma_vec=sigma_vec, want=want, n=n):
+                return intops.mod_pow(sigma_vec[i], n, n) == want[i]
+
+            verdicts = rlc.bisect_rows(range(rounds), check, row_check)
+            out.append(all(verdicts[i] for i in range(rounds)))
         return out
 
     # ------------------------------------------------------------------

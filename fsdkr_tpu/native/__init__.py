@@ -81,6 +81,22 @@ def _gen_window_bits(total_exp_bits: int, terms: int = 1) -> int:
     return best
 
 
+def _gen_window_bits_terms(ebits: Sequence[int]) -> int:
+    """Width-adaptive window for a joint row with heterogeneous term
+    widths (the RLC aggregated rows: n short 128-384-bit terms, and a
+    ~168-bit shared chain): per-term lookups cost ceil(ebits_t / w),
+    the per-term tables 2^w - 2 multiplies each, and the shared
+    squaring chain (max ebits_t) is w-independent — so many short terms
+    push the optimum down to w=4 even when the summed width alone would
+    pick w=6."""
+    best, best_cost = 4, None
+    for w in (4, 5, 6):
+        cost = sum(-(-eb // w) for eb in ebits) + len(ebits) * ((1 << w) - 2)
+        if best_cost is None or cost < best_cost:
+            best, best_cost = w, cost
+    return best
+
+
 def _get() -> Optional[ctypes.CDLL]:
     return _LIB.get()
 
@@ -314,14 +330,19 @@ def multi_modexp_batch(
 ) -> List[int]:
     """Joint (Straus) multi-exponentiation: one interleaved windowed
     ladder per row, prod_t bases[r][t]^exps[r][t] mod mods[r]. All rows
-    must carry the same term count k; exponents must be non-negative
+    must carry the same term count k — from 2-term verifier equations up
+    to the n-term RLC aggregated groups (backend.rlc); the native kernel
+    allocates its per-term tables on the heap, so k is bounded only by
+    the 4096-term allocation backstop. Exponents must be non-negative
     (negative exponents are folded upstream by inverting the base —
     backend.powm). The shared squaring chain is as deep as the widest
     term's window count; per-term window counts follow the launch-wide
     max width of that term position, so a k-term row of full-width
     exponents costs ~(max_E + sum_E/4) Montgomery operations instead of
-    ~1.27 * sum_E. Falls back to row-wise CPython pow products when the
-    native core is unavailable or a modulus is even/oversized."""
+    ~1.27 * sum_E, and an n-term aggregate row shares one short chain
+    across all n lookups. Falls back to row-wise CPython pow products
+    when the native core is unavailable or a modulus is
+    even/oversized."""
     if not bases:
         return []
     if not (len(bases) == len(exps) == len(mods)):
@@ -358,7 +379,7 @@ def multi_modexp_batch(
     if (
         lib is None
         or L > _MAX_LIMBS
-        or k > 8
+        or k > 4096  # keep in sync with MAXK in csrc
         or EL > 2 * _MAX_LIMBS
         or any(m % 2 == 0 or m <= 1 for m in mods)
         or any(e_t < 0 for e in exps for e_t in e)
@@ -380,7 +401,7 @@ def multi_modexp_batch(
     ebits_arr = (ctypes.c_int * k)(*ebits)
     rc = lib.fsdkr_multi_modexp_batch(
         base_buf, exp_buf, mod_buf, out_buf, ebits_arr, rows, k, L, EL,
-        _gen_window_bits(sum(ebits), k),
+        _gen_window_bits_terms(ebits),
     )
     if rc != 0:
         _wipe_buf(base_buf, exp_buf, mod_buf, out_buf)

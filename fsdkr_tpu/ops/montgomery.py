@@ -407,10 +407,11 @@ def _multi_modexp_kernel(bases, exps, n, n_prime, r2, one_mont, *, exp_bits_seq)
     idx = jnp.arange(1 << _WINDOW, dtype=_U32)[:, None, None]
 
     def window_step(wi, acc, active):
-        """One shared window: 4 squarings then a lookup per active term.
-        wi counts from the TOP of the shared chain."""
+        """One shared window: 4 squarings then the active terms' table
+        entries folded into acc. wi counts from the TOP of the chain."""
         for _ in range(_WINDOW):
             acc = mont_mul_limbs(acc, acc, n, n_prime)
+        sels = []
         for t in active:
             w_t = exp_bits_seq[t] // _WINDOW
             # this term's digit index from its own MSB end (wi is traced,
@@ -421,12 +422,34 @@ def _multi_modexp_kernel(bases, exps, n, n_prime, r2, one_mont, *, exp_bits_seq)
             )
             sh = (shift % LIMB_BITS).astype(_U32)
             d = (limb >> sh) & ((1 << _WINDOW) - 1)
-            sel = jnp.sum(
+            sels.append(jnp.sum(
                 jnp.where(d[None, :, None] == idx, table[:, t], jnp.uint32(0)),
                 axis=0,
+            ))
+        if len(sels) < 4:  # few-term rows: the sequential fold's shape
+            for sel in sels:
+                acc = mont_mul_limbs(acc, sel, n, n_prime)
+            return acc
+        # n-term rows (the RLC aggregated groups): fold the selected
+        # entries in a log-depth tree of batched Montgomery products —
+        # log2(k) wide launches instead of k sequential multiplies.
+        # Exact, not approximate: every combine contributes exactly one
+        # R^{-1} like the sequential fold, and odd levels pad with
+        # one_mont (R mod n), the MontMul identity.
+        b_rows_ = acc.shape[0]
+        while len(sels) > 1:
+            if len(sels) % 2:
+                sels.append(one_mont)
+            half = len(sels) // 2
+            a = jnp.concatenate(sels[0::2], axis=0)
+            b = jnp.concatenate(sels[1::2], axis=0)
+            prod = mont_mul_limbs(
+                a, b, jnp.tile(n, (half, 1)), jnp.tile(n_prime, (half,))
             )
-            acc = mont_mul_limbs(acc, sel, n, n_prime)
-        return acc
+            sels = [
+                prod[i * b_rows_ : (i + 1) * b_rows_] for i in range(half)
+            ]
+        return mont_mul_limbs(acc, sels[0], n, n_prime)
 
     # segments: between consecutive distinct term widths the active-term
     # set is constant, so the window loop runs as a static ladder of

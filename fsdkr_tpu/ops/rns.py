@@ -998,6 +998,7 @@ def _rns_multi_modexp_kernel(
     def window_step(wi, acc, active):
         for _ in range(WINDOW_BITS):
             acc = _rns_mont_mul(acc, acc, consts_b)
+        sels = []
         for t in active:
             w_t = exp_bits_seq[t] // WINDOW_BITS
             shift = exp_bits_seq[t] - WINDOW_BITS * (wi - (w_total - w_t) + 1)
@@ -1006,12 +1007,35 @@ def _rns_multi_modexp_kernel(
             )
             sh = (shift % LIMB_BITS).astype(_U32)
             d = (limb >> sh) & ((1 << WINDOW_BITS) - 1)
-            sel = jnp.sum(
+            sels.append(jnp.sum(
                 jnp.where(d[None, :, None] == idx, table[:, t], jnp.uint32(0)),
                 axis=0,
+            ))
+        if len(sels) < 4:  # few-term rows: the sequential fold's shape
+            for sel in sels:
+                acc = _rns_mont_mul(acc, sel, consts_b)
+            return acc
+        # n-term rows (the RLC aggregated groups): log-depth tree of
+        # batched RNS Montgomery products over the selected entries —
+        # exact (one A^{-1} factor per combine, same as the sequential
+        # fold; odd levels pad with one_m, the RNS MontMul identity).
+        # See ops.montgomery._multi_modexp_kernel for the CIOS twin.
+        while len(sels) > 1:
+            if len(sels) % 2:
+                sels.append(one_m)
+            half = len(sels) // 2
+            consts_h = consts_for(
+                jnp.tile(c1_A, (half, 1)), jnp.tile(N_Bmr, (half, 1))
             )
-            acc = _rns_mont_mul(acc, sel, consts_b)
-        return acc
+            prod = _rns_mont_mul(
+                jnp.concatenate(sels[0::2], axis=0),
+                jnp.concatenate(sels[1::2], axis=0),
+                consts_h,
+            )
+            sels = [
+                prod[i * b_rows : (i + 1) * b_rows] for i in range(half)
+            ]
+        return _rns_mont_mul(acc, sels[0], consts_b)
 
     acc = one_m
     starts = [w_total - eb // WINDOW_BITS for eb in exp_bits_seq]

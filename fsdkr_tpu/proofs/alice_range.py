@@ -190,6 +190,36 @@ class AliceProof:
         )
         return AliceProof.generate_finish(state, powm_columns(powm, *cols2))
 
+    # NOTE on FSDKR_RLC: this family does NOT fold into the cross-proof
+    # randomized batch check (backend.rlc). The verifier accepts iff
+    # H(n, c, z, u', w') == e with u', w' RECONSTRUCTED from the response
+    # — the Fiat-Shamir hash binds the per-row group elements themselves,
+    # so there is no per-row equation of the form lhs == rhs whose random
+    # linear combination could replace computing u'/w' individually. The
+    # range columns keep the joint/column path; only the domain gate
+    # below is shared with the RLC-aggregating families (gating must run
+    # before any aggregation or staging in every mode).
+
+    @staticmethod
+    def domain_gate(proof: "AliceProof", cipher: int,
+                    dlog_statement: DLogStatement,
+                    q: int = CURVE_ORDER) -> bool:
+        """Wire-domain gate for one row of the batched verifier, applied
+        BEFORE staging or hashing. s1's q^3 slack bound is the proof's
+        own range gate (`/root/reference/src/range_proofs.rs:125`),
+        enforced pre-launch; s2/e width caps are the honest-value bounds
+        (s2 = e*rho + gamma < q^3 * N~ * 2^{small}); the remaining fields
+        must be non-negative for chain_int / the limb encoder."""
+        return (
+            0 <= proof.s1 <= q**3
+            and 0 <= proof.s2
+            and proof.s2.bit_length() <= dlog_statement.N.bit_length() + 832
+            and 0 <= proof.e < (1 << 256)
+            and proof.z >= 0
+            and proof.s >= 0
+            and cipher >= 0
+        )
+
     def verify(
         self,
         cipher: int,

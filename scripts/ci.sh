@@ -38,4 +38,13 @@ FSDKR_THREADS=8 python -m pytest tests/test_thread_parity.py \
   tests/test_cache_isolation.py -q -m "not slow and not heavy" \
   -p no:cacheprovider
 
+echo "== test: FSDKR_RLC=0 leg (per-row column path) =="
+# the smoke tier above ran with the default FSDKR_RLC=1 (randomized
+# batch verification, bisection fallback); this leg forces the per-row
+# column path on the verifier-facing suites so the fallback the
+# bisection depends on cannot rot unexercised
+FSDKR_RLC=0 python -m pytest tests/test_rlc.py tests/test_tamper.py \
+  tests/test_join_tamper.py tests/test_tpu_backend.py -q \
+  -m "not slow and not heavy" -p no:cacheprovider
+
 echo "== ci.sh: all gates green =="

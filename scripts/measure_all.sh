@@ -151,6 +151,12 @@ run n64_hostec 3600 BENCH_N=64 BENCH_T=32 FSDKR_DEVICE_EC=0 FSDKR_TRACE=1 python
 # the per-term column path on identical kernels; CPU-platform pair is in
 # BASELINE.md round 6)
 run n16_nomultiexp 2400 FSDKR_MULTIEXP=0 FSDKR_TRACE=1 python bench.py
+# cross-proof randomized batch verification A/B (FSDKR_RLC: =0 reverts
+# the verifier to per-row columns; =1 is the default fold — the nominal
+# n16 step above already measures it and emits the fold statistics
+# {rlc_groups, rows_folded, bisect_fallbacks, fullwidth_ladders} as the
+# bench JSON's "rlc" field)
+run n16_norlc 2400 FSDKR_RLC=0 FSDKR_TRACE=1 python bench.py
 
 # host-engine thread scaling (FSDKR_THREADS row pool; 1 = the historical
 # serial loop, auto = all cores). Pinned to the CPU platform + host
@@ -158,10 +164,25 @@ run n16_nomultiexp 2400 FSDKR_MULTIEXP=0 FSDKR_TRACE=1 python bench.py
 # outage; the warm collect's powm_cache field in each JSON shows the
 # persistent-table hit counts (second collect of the same committee must
 # show the table builds eliminated).
-for T in 1 4 8 auto; do
-  run_local "n16_host_t$T" 3600 BENCH_PLATFORM=cpu FSDKR_THREADS=$T \
-    FSDKR_DEVICE_POWM=0 FSDKR_DEVICE_EC=0 FSDKR_TRACE=1 python bench.py
-done
+# On a single-core host the 1/4/8 series is SKIPPED, not measured: every
+# point would time the same serial loop and the resulting flat "1x
+# scaling" would read as a thread-pool regression. The skip is annotated
+# in a marker JSON; only the auto point runs — it doubles as the
+# canonical host datapoint below and self-describes its real pool size
+# via the fsdkr_threads field.
+if [ "$(nproc)" -gt 1 ]; then
+  rm -f "$R/m_threads_scaling_skipped.json"
+  for T in 1 4 8; do
+    run_local "n16_host_t$T" 3600 BENCH_PLATFORM=cpu FSDKR_THREADS=$T \
+      FSDKR_DEVICE_POWM=0 FSDKR_DEVICE_EC=0 FSDKR_TRACE=1 python bench.py
+  done
+else
+  echo "single-core host: skipping the FSDKR_THREADS scaling series"
+  printf '{"skipped": "FSDKR_THREADS 1/4/8 scaling series", "reason": "nproc=1: every point would measure the identical serial loop and report a misleading 1x scaling figure", "nproc": 1}\n' \
+    > "$R/m_threads_scaling_skipped.json"
+fi
+run_local "n16_host_tauto" 3600 BENCH_PLATFORM=cpu FSDKR_THREADS=auto \
+  FSDKR_DEVICE_POWM=0 FSDKR_DEVICE_EC=0 FSDKR_TRACE=1 python bench.py
 
 # canonical BENCH datapoint from the battery, copied to the repo root so
 # the round's bench trajectory is populated even if the driver never

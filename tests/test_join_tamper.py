@@ -104,6 +104,31 @@ def test_tampered_join_rejected(join_round, test_config, name, err, mutate):
         _collect_with_join(join_round, test_config, mutate)
 
 
+@pytest.mark.parametrize("name,err,mutate", CASES, ids=[c[0] for c in CASES])
+def test_rlc_join_verdicts_identical(
+    join_round, test_config, monkeypatch, name, err, mutate
+):
+    """FSDKR_RLC A/B over the join tamper matrix on the batched backend:
+    the RLC-folded families a join exercises (correct-key,
+    ring-Pedersen) and the unfolded composite-dlog path must raise the
+    same identifiable-abort error (type + party attribution) in both
+    legs — the bisection fallback preserves exact blame."""
+    monkeypatch.setenv("FSDKR_DEVICE_POWM", "0")
+    monkeypatch.setenv("FSDKR_DEVICE_EC", "0")
+    seen = {}
+    for leg in ("0", "1"):
+        monkeypatch.setenv("FSDKR_RLC", leg)
+        with pytest.raises(err) as ei:
+            _collect_with_join(
+                join_round, test_config.with_backend("tpu"), mutate
+            )
+        seen[leg] = (
+            type(ei.value).__name__,
+            getattr(ei.value, "party_index", None),
+        )
+    assert seen["0"] == seen["1"]
+
+
 def test_honest_join_accepted(join_round, test_config):
     """Baseline: the fixture's join is genuinely valid, and the new
     party derives a working LocalKey whose share matches the committee."""

@@ -1,0 +1,191 @@
+"""Cross-proof randomized batch verification (FSDKR_RLC, backend.rlc):
+planner/fold algebra, the variable-arity joint-ladder engines, and the
+bisection driver.
+
+Collect-level A/B identity and blame attribution live in
+tests/test_tamper.py (refresh surface) and tests/test_join_tamper.py
+(join surface); this file pins the building blocks at engine level.
+"""
+
+import random
+
+import pytest
+
+from fsdkr_tpu.backend import rlc
+from fsdkr_tpu.backend.powm import multi_powm
+
+
+def _oracle(bases_rows, exps_rows, moduli):
+    out = []
+    for bs, es, m in zip(bases_rows, exps_rows, moduli):
+        acc = 1
+        for b, e in zip(bs, es):
+            acc = acc * pow(b % m, e, m) % m
+        out.append(acc)
+    return out
+
+
+def _random_rows(rng, rows, k, mod_bits, exp_bits):
+    mods, bases, exps = [], [], []
+    for _ in range(rows):
+        m = rng.getrandbits(mod_bits) | (1 << (mod_bits - 1)) | 1
+        mods.append(m)
+        bases.append(tuple(rng.randrange(1, m) for _ in range(k)))
+        exps.append(tuple(rng.getrandbits(w) for w in exp_bits))
+    return bases, exps, mods
+
+
+@pytest.mark.parametrize("k", [2, 9, 33])
+def test_host_joint_ladder_variable_arity(k):
+    """The native engine (and its CPython fallback) handles n-term rows —
+    k=9 and k=33 cross the old 8-term cap."""
+    rng = random.Random(1000 + k)
+    widths = [128 if t % 2 else 384 for t in range(k)]
+    bases, exps, mods = _random_rows(rng, 5, k, 512, widths)
+    assert multi_powm(bases, exps, mods, device=False) == _oracle(
+        bases, exps, mods
+    )
+
+
+@pytest.mark.parametrize("k", [9, 17, 21])
+def test_device_joint_ladder_variable_arity(k):
+    """Device routing for n-term rows: rows wider than the
+    FSDKR_DEVICE_MAX_TERMS cap split into sub-rows (partials recombined
+    host-side), so the compiled kernel variants stay bounded while the
+    result is exactly the oracle product."""
+    rng = random.Random(2000 + k)
+    widths = [128] * k
+    bases, exps, mods = _random_rows(rng, 4, k, 256, widths)
+    assert multi_powm(bases, exps, mods, device=True) == _oracle(
+        bases, exps, mods
+    )
+
+
+def test_device_tree_fold_matches_sequential():
+    """The CIOS kernel's log-depth tree fold (>= 4 active terms) is exact:
+    compare a 5-term device launch against the host oracle."""
+    rng = random.Random(42)
+    bases, exps, mods = _random_rows(rng, 3, 5, 256, [128] * 5)
+    assert multi_powm(bases, exps, mods, device=True) == _oracle(
+        bases, exps, mods
+    )
+
+
+def test_rns_multi_modexp_many_terms():
+    """The RNS kernel's n-term path (tree fold engages at >= 4 active
+    terms), called directly — the row-count router would otherwise only
+    reach it at >= FSDKR_RNS_MIN_ROWS rows."""
+    from fsdkr_tpu.ops.rns import rns_multi_modexp
+
+    rng = random.Random(7)
+    k = 6
+    bases, exps, mods = _random_rows(rng, 4, k, 256, [128] * k)
+    got = rns_multi_modexp(
+        [list(b) for b in bases], [list(e) for e in exps], mods, 256,
+        [128] * k,
+    )
+    assert got == _oracle(bases, exps, mods)
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_sample_rhos_domain():
+    rhos = rlc.sample_rhos(256)
+    assert len(rhos) == 256
+    assert all(1 <= r < (1 << rlc.RLC_BITS) for r in rhos)
+    assert len(set(rhos)) > 250  # 128-bit CSPRNG draws do not collide
+
+
+def test_fold_algebra_ring_pedersen():
+    """The folded equation is exactly the rho-weighted product of the
+    per-row equations: valid rows satisfy it for every rho; an invalid
+    row breaks it for (all but a 2^-128 fraction of) rho."""
+    from fsdkr_tpu.proofs.ring_pedersen import (
+        RingPedersenProof,
+        RingPedersenStatement,
+    )
+    from fsdkr_tpu.core.paillier import EncryptionKey
+
+    rng = random.Random(3)
+    n = 2**255 - 19  # prime, so S is invertible when building honest A_i
+    t = rng.randrange(2, n)
+    lam = rng.randrange(2, n)
+    s = pow(t, lam, n)
+    m_sec = 8
+    z_vec = [rng.randrange(1, n) for _ in range(m_sec)]
+    bits = [rng.getrandbits(1) == 1 for _ in range(m_sec)]
+    a_vec = [
+        pow(t, z, n) * (pow(s, -1, n) if b else 1) % n
+        for z, b in zip(z_vec, bits)
+    ]
+    st = RingPedersenStatement(S=s, T=t, N=n, ek=EncryptionKey.from_n(n))
+    proof = RingPedersenProof(A=a_vec, Z=z_vec)
+    rhos = rlc.sample_rhos(m_sec)
+    lhs, rhs = RingPedersenProof.rlc_fold(st, proof, bits, rhos)
+    (lv,), (rv,) = (
+        multi_powm([lhs[0]], [lhs[1]], [lhs[2]], device=False),
+        multi_powm([rhs[0]], [rhs[1]], [rhs[2]], device=False),
+    )
+    assert lv == rv
+    # break one row: the fold must detect it
+    bad = list(a_vec)
+    bad[3] = bad[3] * 2 % n
+    lhs, rhs = RingPedersenProof.rlc_fold(
+        st, RingPedersenProof(A=bad, Z=z_vec), bits, rlc.sample_rhos(m_sec)
+    )
+    (lv,), (rv,) = (
+        multi_powm([lhs[0]], [lhs[1]], [lhs[2]], device=False),
+        multi_powm([rhs[0]], [rhs[1]], [rhs[2]], device=False),
+    )
+    assert lv != rv
+
+
+def test_fold_algebra_pdl_nn_closed_form():
+    """rlc_fold_nn's closed-form (1+n)-power: prod_j (1 + s1_j n)^{rho_j}
+    == 1 + (sum rho_j s1_j) n (mod n^2), checked against pow()."""
+    from fsdkr_tpu.proofs.pdl_slack import PDLwSlackProof
+
+    rng = random.Random(4)
+    n = (rng.getrandbits(128) | (1 << 127)) | 1
+    nn = n * n
+    rows = [
+        (1, 1, 0, rng.getrandbits(160), 1)  # (u2, c, e, s1, s2)
+        for _ in range(5)
+    ]
+    rhos = rlc.sample_rhos(5)
+    _, _, gs1 = PDLwSlackProof.rlc_fold_nn(n, nn, rows, rhos)
+    want = 1
+    for r, (_, _, _, s1, _) in zip(rhos, rows):
+        want = want * pow(1 + (s1 % n) * n, r, nn) % nn
+    assert gs1 == want
+
+
+def test_bisect_rows_finds_bad_subset():
+    """Synthetic group: rows 5 and 11 are bad. The driver must return
+    exact verdicts and touch only O(bad * log n) combined checks."""
+    bad = {5, 11}
+    calls = {"combined": 0, "row": 0}
+
+    def combined(sub):
+        calls["combined"] += 1
+        return not (set(sub) & bad)
+
+    def row(i):
+        calls["row"] += 1
+        return i not in bad
+
+    verdicts = rlc.bisect_rows(list(range(16)), combined, row)
+    assert verdicts == {i: i not in bad for i in range(16)}
+    assert calls["combined"] <= 14
+    assert calls["row"] <= 8
+
+
+def test_stats_counters():
+    rlc.stats_reset()
+    rlc.count("rlc_groups", 3)
+    rlc.count("bisect_fallbacks")
+    s = rlc.stats()
+    assert s["rlc_groups"] == 3 and s["bisect_fallbacks"] == 1
+    rlc.stats_reset()
+    assert rlc.stats()["rlc_groups"] == 0

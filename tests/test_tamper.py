@@ -173,6 +173,164 @@ def test_tampered_broadcast_rejected(
         _collect_tampered(refreshed, test_config.with_backend(backend), mutate)
 
 
+# ---- FSDKR_RLC (cross-proof randomized batch verification) -----------
+# The RLC path must be verdict-identical to the per-row column path on
+# honest AND tampered transcripts (its combined-check failures bisect
+# down to exact per-row verdicts), and a single tampered proof must
+# blame exactly the culpable party through the bisection path.
+
+# tampers covering every RLC-folded family (PDL eq2+eq3, ring-Pedersen,
+# correct-key) plus the unfolded range family and a domain-gated row
+_RLC_CASE_NAMES = (
+    "pdl_proof_s1",
+    "range_proof_s",
+    "ring_pedersen_Z",
+    "correct_key_sigma",
+    "negative_pdl_s3",
+)
+RLC_CASES = [c for c in CASES if c[0] in _RLC_CASE_NAMES]
+
+
+def _err_key(e):
+    """Comparable identity of an identifiable-abort error: type plus the
+    attribution fields (per-equation booleans / party index)."""
+    return (
+        type(e).__name__,
+        getattr(e, "is_u1_eq", None),
+        getattr(e, "is_u2_eq", None),
+        getattr(e, "is_u3_eq", None),
+        getattr(e, "party_index", None),
+    )
+
+
+@pytest.mark.parametrize("name,err,mutate", RLC_CASES, ids=[c[0] for c in RLC_CASES])
+def test_rlc_verdicts_identical_to_column_path(
+    refreshed, test_config, monkeypatch, name, err, mutate
+):
+    """Collect-level A/B: FSDKR_RLC=1 raises the exact same
+    identifiable-abort error (type + attribution fields) as the =0
+    column path on a tampered transcript. Host engines: the planner and
+    bisection logic are engine-independent, and the device kernels are
+    covered by tests/test_rlc.py."""
+    monkeypatch.setenv("FSDKR_DEVICE_POWM", "0")
+    monkeypatch.setenv("FSDKR_DEVICE_EC", "0")
+    keys = {}
+    for leg in ("0", "1"):
+        monkeypatch.setenv("FSDKR_RLC", leg)
+        with pytest.raises(err) as ei:
+            _collect_tampered(
+                refreshed, test_config.with_backend("tpu"), mutate
+            )
+        keys[leg] = _err_key(ei.value)
+    assert keys["0"] == keys["1"]
+
+
+def test_rlc_honest_verdicts_identical(refreshed, test_config, monkeypatch):
+    """Collect-level A/B on an honest transcript: both legs accept, and
+    the RLC leg actually folded (groups > 0, no bisection)."""
+    from fsdkr_tpu.backend import rlc
+
+    monkeypatch.setenv("FSDKR_DEVICE_POWM", "0")
+    monkeypatch.setenv("FSDKR_DEVICE_EC", "0")
+    monkeypatch.setenv("FSDKR_RLC", "0")
+    _collect_tampered(refreshed, test_config.with_backend("tpu"), lambda m: None)
+    monkeypatch.setenv("FSDKR_RLC", "1")
+    rlc.stats_reset()
+    _collect_tampered(
+        refreshed, test_config.with_backend("tpu"), lambda m: None, collector=2
+    )
+    s = rlc.stats()
+    assert s["rlc_groups"] > 0
+    assert s["rows_folded"] > s["rlc_groups"]
+    assert s["bisect_fallbacks"] == 0
+    # the O(1)-per-group property the fold exists for
+    assert s["fullwidth_ladders"] <= 2 * s["rlc_groups"]
+
+
+@pytest.fixture(scope="module")
+def committee16(test_config):
+    """(t=1, n=16) honest round for the bisection-blame test: 16-row RLC
+    groups give the bisection four levels to walk."""
+    from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+
+    keys = simulate_keygen(1, 16, test_config)
+    results = RefreshMessage.distribute_batch(
+        [(k.i, k) for k in keys], 16, test_config
+    )
+    return keys, [m for m, _ in results], [dk for _, dk in results]
+
+
+@pytest.mark.heavy  # n=16 keygen+distribute: tier-1, not the smoke gate
+def test_rlc_bisection_blames_exact_party_n16(
+    committee16, test_config, monkeypatch
+):
+    """Satellite gate: under FSDKR_RLC=1 a single tampered proof at n=16
+    blames exactly the culpable (sender, receiver) row through the
+    bisection path, and the full per-row verdict vector is bit-identical
+    to FSDKR_RLC=0."""
+    from fsdkr_tpu.backend import rlc
+    from fsdkr_tpu.backend.batch_verifier import get_backend
+    from fsdkr_tpu.core.secp256k1 import GENERATOR
+    from fsdkr_tpu.proofs.pdl_slack import PDLwSlackStatement
+
+    monkeypatch.setenv("FSDKR_DEVICE_POWM", "0")
+    monkeypatch.setenv("FSDKR_DEVICE_EC", "0")
+    keys, msgs, _dks = committee16
+    msgs = copy.deepcopy(msgs)
+    key = keys[0]
+    n = 16
+    bad_sender, bad_receiver = 7, 3
+    p = msgs[bad_sender].pdl_proof_vec[bad_receiver]
+    msgs[bad_sender].pdl_proof_vec[bad_receiver] = dataclasses.replace(
+        p, s2=p.s2 + 1  # breaks eq2 only: eq3 and u1 stay valid
+    )
+
+    pdl_items, range_items = [], []
+    for msg in msgs:
+        for i in range(n):
+            st = PDLwSlackStatement(
+                ciphertext=msg.points_encrypted_vec[i],
+                ek=key.paillier_key_vec[i],
+                Q=msg.points_committed_vec[i],
+                G=GENERATOR,
+                h1=key.h1_h2_n_tilde_vec[i].g,
+                h2=key.h1_h2_n_tilde_vec[i].ni,
+                N_tilde=key.h1_h2_n_tilde_vec[i].N,
+            )
+            pdl_items.append((msg.pdl_proof_vec[i], st))
+            range_items.append(
+                (
+                    msg.range_proofs[i],
+                    msg.points_encrypted_vec[i],
+                    key.paillier_key_vec[i],
+                    key.h1_h2_n_tilde_vec[i],
+                )
+            )
+    bad_row = bad_sender * n + bad_receiver
+
+    verdicts = {}
+    for leg in ("0", "1"):
+        monkeypatch.setenv("FSDKR_RLC", leg)
+        rlc.stats_reset()
+        backend = get_backend(test_config.with_backend("tpu"))
+        pdl_v, range_v = backend.verify_pairs(pdl_items, range_items)
+        verdicts[leg] = (pdl_v, range_v)
+        if leg == "1":
+            s = rlc.stats()
+            assert s["bisect_fallbacks"] >= 1  # the bisection path ran
+            assert s["rows_folded"] >= 2 * n * n - 2
+            # O(1) full-width ladders per group, not O(rows)
+            assert s["fullwidth_ladders"] <= 2 * s["rlc_groups"]
+    assert verdicts["1"] == verdicts["0"]
+    pdl_v, range_v = verdicts["1"]
+    assert all(range_v)
+    for row, v in enumerate(pdl_v):
+        if row == bad_row:
+            assert v == (True, False, True)  # exactly eq2, exactly this row
+        else:
+            assert v is None
+
+
 def test_too_few_messages(refreshed, test_config):
     keys, msgs, dks = refreshed
     with pytest.raises(PartiesThresholdViolation):

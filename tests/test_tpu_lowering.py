@@ -173,6 +173,30 @@ class TestKernelsLowerForTpu:
         for fn, args, kwargs in calls:
             lower_for_tpu(fn, args, kwargs)
 
+    def test_cios_multi_exp_nterm_tree(self):
+        """n-term RLC aggregate rows (FSDKR_RLC): >= 4 active terms
+        engage the kernel's log-depth tree fold of the selected window
+        entries — that shape must lower for TPU like the 2-term one."""
+        k = 5
+        moduli = [
+            secrets.randbits(BITS) | (1 << (BITS - 1)) | 1 for _ in range(8)
+        ]
+        bases = [
+            tuple(secrets.randbelow(n - 1) + 1 for _ in range(k))
+            for n in moduli
+        ]
+        exps = [
+            tuple(secrets.randbits(128) for _ in range(k)) for _ in moduli
+        ]
+        calls = []
+        with capture_calls(montgomery, "_multi_modexp_kernel", calls):
+            montgomery.multi_modexp(
+                bases, exps, moduli, limbs_for_bits(BITS), (128,) * k
+            )
+        assert calls, "driver never reached the multi-exp kernel"
+        for fn, args, kwargs in calls:
+            lower_for_tpu(fn, args, kwargs)
+
     def test_rns_multi_exp(self, monkeypatch):
         monkeypatch.setenv("FSDKR_PALLAS", "0")
         moduli = [
