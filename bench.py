@@ -207,6 +207,21 @@ def _jax_cache_entries() -> int:
         return 0
 
 
+def crt_fields():
+    """Statistics of the secret-CRT prover engine (FSDKR_CRT,
+    fsdkr_tpu.backend.crt), accumulated since the caller's stats_reset:
+    rows routed / half-width legs computed / Bellcore fault checks run /
+    full-width fallback rows / exponent bits saved by the leg-order
+    reductions, plus the per-session secret store's counters. On an
+    honest run fault_checks == legs and fallback_rows == 0."""
+    from fsdkr_tpu.backend import crt
+
+    return {
+        "crt_enabled": crt.crt_enabled(),
+        "crt": {**crt.crt_stats(), "store": crt.store_stats()},
+    }
+
+
 def rlc_fields():
     """Fold statistics of the cross-proof randomized batch verifier
     (FSDKR_RLC, fsdkr_tpu.backend.rlc), accumulated since the caller's
@@ -448,7 +463,8 @@ def main():
 
     from fsdkr_tpu.utils.trace import get_tracer
 
-    # prover-side phase split (includes first-launch compiles)
+    # prover-side phase split (includes first-launch compiles), now with
+    # the stage-1 sub-phases (sample / enc+beta wall / mod-N~ columns)
     dist_stats = get_tracer().stats()
     trace_distribute = {
         name: round(st.seconds, 3)
@@ -459,6 +475,42 @@ def main():
         t_distribute,
         {k: v for k, v in dist_stats.items() if k.startswith("distribute.")},
     ).get("mfu")
+
+    # --- WARM-epoch distribute: proactive refresh re-runs on the same
+    # committee, so the persistent (h1/h2, N~) comb tables are hot and
+    # precompute is skipped — this is the prover number the round-8
+    # acceptance A/B compares (crt_ab_n16_{on,off}). The extra run
+    # re-mutates each key's vss_scheme exactly like a next epoch would;
+    # collect below verifies the COLD run's messages, which carry their
+    # own committed schemes.
+    from fsdkr_tpu.backend import crt as crt_mod
+    from fsdkr_tpu.backend.powm import powm_cache_stats
+
+    get_tracer().reset()
+    crt_mod.stats_reset()
+    cache_d0 = powm_cache_stats()
+    t0 = time.time()
+    RefreshMessage.distribute_batch([(key.i, key) for key in keys], n, tpu_cfg)
+    t_distribute_warm = time.time() - t0
+    cache_d1 = powm_cache_stats()
+    log(
+        f"distribute warm: {t_distribute_warm:.2f}s (cold {t_distribute:.2f}s; "
+        f"prover comb cache +{cache_d1['hits'] - cache_d0['hits']} hits, "
+        f"+{cache_d1['misses'] - cache_d0['misses']} misses)"
+    )
+    trace_distribute_warm = {
+        name: round(st.seconds, 3)
+        for name, st in get_tracer().stats().items()
+        if name.startswith("distribute.")
+    } or None
+    crt_out = crt_fields()
+    # prover-side comb cache counters (hits/misses across the warm
+    # distribute): misses_warm == 0 means every stage-1 fixed-base table
+    # was served from the persistent LRU
+    powm_cache_distribute = {
+        "hits_warm": cache_d1["hits"] - cache_d0["hits"],
+        "misses_warm": cache_d1["misses"] - cache_d0["misses"],
+    }
 
     # proof instances verified by one collect (excluding n^2 Feldman EC
     # checks and 2 joins' dlog proofs, which are zero here)
@@ -575,10 +627,18 @@ def main():
 
     t_host_native = measure_host("native-c++")
 
-    intops._native_modexp = False  # force CPython pow
+    # force CPython pow for the cpython arm: the env switch covers the
+    # per-call GMP route, the module flag the cached own-core route
+    saved_np = os.environ.get("FSDKR_NATIVE_POW")
+    os.environ["FSDKR_NATIVE_POW"] = "0"
+    intops._native_modexp = False
     try:
         t_host_py = measure_host("cpython")
     finally:
+        if saved_np is None:
+            os.environ.pop("FSDKR_NATIVE_POW", None)
+        else:
+            os.environ["FSDKR_NATIVE_POW"] = saved_np
         intops._native_modexp = None  # restore autodetect
 
     result = {
@@ -605,6 +665,9 @@ def main():
         "compile_overhead_s": round(t_tpu_cold - t_tpu, 2),
         "fresh_compiles": cache_after - cache_before,
         "distribute_batch_s": round(t_distribute, 2),
+        "distribute_warm_s": round(t_distribute_warm, 2),
+        "powm_cache_distribute": powm_cache_distribute,
+        **crt_out,
         # persistent precompute cache (comb tables / power ladders /
         # Montgomery contexts): warm-collect deltas — misses_warm == 0
         # means every table build was served from the cache
@@ -623,6 +686,8 @@ def main():
         result["trace"] = trace_out  # warm-collect per-phase seconds
     if trace_distribute:
         result["trace_distribute"] = trace_distribute
+    if trace_distribute_warm:
+        result["trace_distribute_warm"] = trace_distribute_warm
     result.update(rf)  # per-phase {gmacs, mfu} + mfu_collect + peak_macs
     if mfu_distribute:
         result["mfu_distribute"] = mfu_distribute

@@ -270,18 +270,20 @@ static void mont_constants(const u64 *n, int L, u64 *r_mod, u64 *r2_mod) {
 // windows trade table-build multiplies for fewer per-window lookups, so
 // w=6 wins for full-width exponents and w=4 for short ones), MSB-first.
 
-int fsdkr_modexp_w(const u64 *base, const u64 *exp, const u64 *n, u64 *out,
-                   int L, int EL, int wbits) {
+// Core ladder against caller-owned Montgomery constants (n0inv, one_m,
+// r2). Wipes every temporary it creates (reduced base, Montgomery base,
+// window table, accumulator) but NOT the constants — the CRT leg batch
+// amortizes one mont_constants over a run of equal-modulus rows and
+// wipes them once per run.
+static int modexp_core(const u64 *base, const u64 *exp, const u64 *n,
+                       u64 n0inv, const u64 *one_m, const u64 *r2, u64 *out,
+                       int L, int EL, int wbits) {
   // wbits capped at 6: the 2^wbits-entry stack table is 32 KB there, and
   // the build-vs-lookup tradeoff already tips back past w=6 for every
   // protocol exponent width
   if (L <= 0 || L > MAXL || EL <= 0 || wbits < 1 || wbits > 6 ||
       !(n[0] & 1))
     return -1;
-
-  const u64 n0inv = mont_n0inv(n[0]);
-  u64 one_m[MAXL], r2[MAXL];
-  mont_constants(n, L, one_m, r2);
 
   // reduce base below n (base < 2^(64L); subtract n a few times if needed —
   // callers pass base < n, this is just a guard)
@@ -316,19 +318,15 @@ int fsdkr_modexp_w(const u64 *base, const u64 *exp, const u64 *n, u64 *out,
           break;
         }
   u64 acc[MAXL];
+  u64 onev[MAXL];
+  std::memset(onev, 0, sizeof(u64) * L);
+  onev[0] = 1;
   if (top_bit < 0) { // exp == 0
     std::memcpy(out, one_m, sizeof(u64) * L);
-    u64 onev[MAXL];
-    std::memset(onev, 0, sizeof(u64) * L);
-    onev[0] = 1;
     mont_mul(out, out, onev, n, n0inv, L); // leave Montgomery domain -> 1
     secure_wipe(b, L);
     secure_wipe(base_m, L);
     secure_wipe(&table[0][0], D * MAXL);
-    // one_m/r2 reconstruct the modulus (secret on the Paillier-decrypt
-    // path where n = p^2): gcd(R - one_m, R^2 - r2) recovers it
-    secure_wipe(one_m, L);
-    secure_wipe(r2, L);
     return 0;
   }
 
@@ -346,17 +344,28 @@ int fsdkr_modexp_w(const u64 *base, const u64 *exp, const u64 *n, u64 *out,
     mont_mul(acc, acc, table[d], n, n0inv, L);
   }
 
-  u64 onev[MAXL];
-  std::memset(onev, 0, sizeof(u64) * L);
-  onev[0] = 1;
   mont_mul(out, acc, onev, n, n0inv, L);
   secure_wipe(b, L);
   secure_wipe(base_m, L);
   secure_wipe(&table[0][0], D * MAXL);
   secure_wipe(acc, L);
-  secure_wipe(one_m, L); // see exp==0 branch: these reconstruct n
-  secure_wipe(r2, L);
   return 0;
+}
+
+int fsdkr_modexp_w(const u64 *base, const u64 *exp, const u64 *n, u64 *out,
+                   int L, int EL, int wbits) {
+  if (L <= 0 || L > MAXL || EL <= 0 || wbits < 1 || wbits > 6 ||
+      !(n[0] & 1))
+    return -1;
+  const u64 n0inv = mont_n0inv(n[0]);
+  u64 one_m[MAXL], r2[MAXL];
+  mont_constants(n, L, one_m, r2);
+  int rc = modexp_core(base, exp, n, n0inv, one_m, r2, out, L, EL, wbits);
+  // one_m/r2 reconstruct the modulus (secret on the Paillier-decrypt
+  // path where n = p^2): gcd(R - one_m, R^2 - r2) recovers it
+  secure_wipe(one_m, L);
+  secure_wipe(r2, L);
+  return rc;
 }
 
 // ABI-stable 4-bit-window entry point
@@ -485,6 +494,168 @@ int fsdkr_modexp_batch_w(const u64 *bases, const u64 *exps, const u64 *mods,
 int fsdkr_modexp_batch(const u64 *bases, const u64 *exps, const u64 *mods,
                        u64 *outs, int rows, int L, int EL) {
   return fsdkr_modexp_batch_w(bases, exps, mods, outs, rows, L, EL, 4);
+}
+
+// ---------------------------------------------------------------------------
+// Secret-CRT leg batch: the prover-owned-modulus engine's half-width
+// modexp legs (backend/crt.py). Rows are the p/q legs of CRT-decomposed
+// exponentiations — base and exponent already reduced by the Python
+// planner (base mod p*r, exponent mod lcm(p-1, r-1) with r the fresh
+// 64-bit fault-check prime), so every operand here is SECRET-DERIVED:
+// the modulus itself contains a factor of the prover's key. Semantics
+// are row-wise modexp exactly like fsdkr_modexp_batch_w, with one
+// difference exploited by the planner's row layout: Montgomery
+// constants (the ~60-montmul doubling ladder of mont_constants) are
+// computed once per RUN of equal consecutive moduli instead of once per
+// row — CRT legs arrive grouped per context (a correct-key proof
+// submits `rounds` consecutive rows mod the same p*r), so constants
+// amortize over each group. Thread-chunk boundaries recompute the run
+// constants at their first row, so the split is bit-identical to the
+// serial loop. Constants are wiped at every run boundary (they
+// reconstruct the secret leg modulus via gcd(R - one_m, R^2 - r2)).
+
+int fsdkr_crt_modexp_batch(const u64 *bases, const u64 *exps, const u64 *mods,
+                           u64 *outs, int rows, int L, int EL, int wbits) {
+  if (L <= 0 || L > MAXL || EL <= 0 || rows <= 0 || wbits < 1 || wbits > 6)
+    return -1;
+  for (int r = 0; r < rows; r++)
+    if (!(mods[(size_t)r * L] & 1))
+      return -1;
+  std::atomic<int> rc{0};
+  parallel_rows(rows, [&](int lo, int hi) {
+    u64 one_m[MAXL], r2[MAXL];
+    const u64 *cur_n = nullptr;
+    u64 n0inv = 0;
+    for (int i = lo; i < hi; i++) {
+      if (rc.load(std::memory_order_relaxed) != 0)
+        break;
+      const u64 *n = mods + (size_t)i * L;
+      if (cur_n == nullptr || std::memcmp(n, cur_n, sizeof(u64) * L) != 0) {
+        if (cur_n != nullptr) { // run boundary: old constants are secret
+          secure_wipe(one_m, L);
+          secure_wipe(r2, L);
+        }
+        n0inv = mont_n0inv(n[0]);
+        mont_constants(n, L, one_m, r2);
+        cur_n = n;
+      }
+      int r = modexp_core(bases + (size_t)i * L, exps + (size_t)i * EL, n,
+                          n0inv, one_m, r2, outs + (size_t)i * L, L, EL,
+                          wbits);
+      if (r != 0)
+        rc.store(r, std::memory_order_relaxed);
+    }
+    secure_wipe(one_m, MAXL);
+    secure_wipe(r2, MAXL);
+  });
+  return rc.load();
+}
+
+// ---------------------------------------------------------------------------
+// Row-parallel Miller-Rabin batch: the prime-generation shape (many
+// candidates, each with its own CSPRNG witnesses) — candidates split
+// across the FSDKR_THREADS row pool, rounds run serially per candidate
+// with composite short-circuit. verdicts[i]: 1 probable prime, 0
+// composite. The single-candidate entry point (fsdkr_miller_rabin,
+// round-parallel) stays for the confirmation call on one candidate;
+// this one kills the per-candidate bridge overhead of the generation
+// loop (one staging + one native call for a whole sieve window).
+
+static int mr_test_row(const u64 *n, int L, const u64 *wits, int rounds) {
+  if (!(n[0] & 1))
+    return -1;
+  // n == 1 would make d = n-1 = 0 and spin the shift loop below forever;
+  // the ABI entry validates nothing beyond oddness, so guard here
+  bool gt_one = n[0] > 1;
+  for (int i = 1; i < L && !gt_one; i++)
+    gt_one = n[i] != 0;
+  if (!gt_one)
+    return -1;
+  const u64 n0inv = mont_n0inv(n[0]);
+  u64 one_m[MAXL], r2[MAXL];
+  mont_constants(n, L, one_m, r2);
+
+  u64 n1[MAXL], d[MAXL], onev[MAXL];
+  std::memset(onev, 0, sizeof(u64) * L);
+  onev[0] = 1;
+  sub_limbs(n1, n, onev, L);
+  std::memcpy(d, n1, sizeof(u64) * L);
+  int r = 0;
+  while (!(d[0] & 1)) {
+    for (int i = 0; i < L - 1; i++)
+      d[i] = (d[i] >> 1) | (d[i + 1] << 63);
+    d[L - 1] >>= 1;
+    r++;
+  }
+  u64 n1_m[MAXL];
+  mont_mul(n1_m, n1, r2, n, n0inv, L);
+
+  int top_bit = -1;
+  for (int i = L - 1; i >= 0 && top_bit < 0; i--)
+    if (d[i])
+      for (int bit = 63; bit >= 0; bit--)
+        if ((d[i] >> bit) & 1) {
+          top_bit = i * 64 + bit;
+          break;
+        }
+
+  bool composite = false;
+  u64 a_m[MAXL], ared[MAXL], x[MAXL];
+  for (int round = 0; round < rounds && !composite; round++) {
+    const u64 *a = wits + (size_t)round * L;
+    std::memcpy(ared, a, sizeof(u64) * L);
+    while (cmp_limbs(ared, n, L) >= 0)
+      sub_limbs(ared, ared, n, L);
+    mont_mul(a_m, ared, r2, n, n0inv, L);
+    std::memcpy(x, one_m, sizeof(u64) * L);
+    for (int bit = top_bit; bit >= 0; bit--) {
+      mont_sqr(x, x, n, n0inv, L);
+      if ((d[bit / 64] >> (bit % 64)) & 1)
+        mont_mul(x, x, a_m, n, n0inv, L);
+    }
+    if (cmp_limbs(x, one_m, L) == 0 || cmp_limbs(x, n1_m, L) == 0)
+      continue;
+    bool witness = true;
+    for (int i = 0; i < r - 1; i++) {
+      mont_sqr(x, x, n, n0inv, L);
+      if (cmp_limbs(x, n1_m, L) == 0) {
+        witness = false;
+        break;
+      }
+    }
+    if (witness)
+      composite = true;
+  }
+  // every temporary derives from the secret prime candidate
+  secure_wipe(x, MAXL);
+  secure_wipe(a_m, MAXL);
+  secure_wipe(ared, MAXL);
+  secure_wipe(d, L);
+  secure_wipe(n1, L);
+  secure_wipe(n1_m, L);
+  secure_wipe(one_m, L);
+  secure_wipe(r2, L);
+  return composite ? 0 : 1;
+}
+
+int fsdkr_miller_rabin_batch(const u64 *ns, const u64 *witnesses,
+                             int *verdicts, int rows, int L, int rounds) {
+  if (L <= 0 || L > MAXL || rows <= 0 || rounds <= 0)
+    return -1;
+  std::atomic<int> rc{0};
+  parallel_rows(rows, [&](int lo, int hi) {
+    for (int i = lo; i < hi; i++) {
+      if (rc.load(std::memory_order_relaxed) != 0)
+        return;
+      int v = mr_test_row(ns + (size_t)i * L,
+                          L, witnesses + (size_t)i * rounds * L, rounds);
+      if (v < 0)
+        rc.store(-1, std::memory_order_relaxed);
+      else
+        verdicts[i] = v;
+    }
+  });
+  return rc.load();
 }
 
 // Fixed-base comb: out[m] = base^exps[m] mod n for M exponents sharing

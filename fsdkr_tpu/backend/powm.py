@@ -100,13 +100,18 @@ def _pad_pow2(rows: int) -> int:
 
 
 def host_powm(bases, exps, moduli) -> List[int]:
-    """Host batched modexp: the native Montgomery core when available,
-    CPython pow otherwise. Measured on this box (full-width exponents,
-    round 3): 3.9x CPython at 2048 bits (6.9 ms/op), 3.7x at 4096 bits
-    (55.8 ms/op). This is the CPU baseline the TPU backend is
+    """Host batched modexp: the system GMP (the reference's own bigint
+    backend — native/gmp.py, FSDKR_GMP gate) when present, the own
+    native Montgomery core otherwise, CPython pow as the last fallback.
+    Measured on this box at the distribute() wall shape (2048-bit
+    exponent mod a 4096-bit n^2): GMP 10.7 ms/op, own core 20.9 ms/op,
+    CPython 101 ms/op. This is the CPU baseline the TPU backend is
     benchmarked against."""
     from .. import native
+    from ..native import gmp
 
+    if gmp.available():
+        return gmp.powm_batch(list(bases), list(exps), list(moduli))
     return native.modexp_batch(list(bases), list(exps), list(moduli))
 
 
@@ -692,6 +697,30 @@ def tpu_powm_grouped(bases, exps, moduli) -> List[int]:
         for i, v in zip(loners, vals):
             out[i] = v
     return out
+
+
+def crt_powm(bases, exps, moduli, factors, powm=None):
+    """Planner route for prover-owned moduli (FSDKR_CRT, backend.crt):
+    rows whose factorization is supplied as factors[i] = (p, q) ride the
+    secret-CRT engine — two fault-checked half-width legs with exponents
+    reduced mod the leg group orders, Garner-recombined — and rows with
+    factors[i] = None (or with the gate off) take `powm` unchanged.
+    Results are bit-identical to the full-width path (the decomposition
+    is an arithmetic identity; pinned by tests/test_crt.py), so callers
+    thread transcripts through without caring which engine ran."""
+    if powm is None:
+        powm = host_powm
+    from . import crt
+
+    if not crt.crt_enabled() or not any(f is not None for f in factors):
+        return powm(bases, exps, moduli)
+    contexts = [
+        crt.get_context(m, *f) if f is not None else None
+        for m, f in zip(moduli, factors)
+    ]
+    return crt.crt_modexp_batch(
+        bases, exps, contexts, fallback=powm, moduli=moduli
+    )
 
 
 def get_batch_powm(config: ProtocolConfig) -> BatchPowm:

@@ -57,11 +57,22 @@ def _get_native_modexp():
 
 
 def mod_pow(base: int, exp: int, modulus: int) -> int:
-    """base^exp mod modulus for exp >= 0."""
+    """base^exp mod modulus for exp >= 0. Wide odd-modulus rows prefer
+    the system GMP (native/gmp.py — the reference's own backend; gated
+    by FSDKR_GMP AND this module's FSDKR_NATIVE_POW oracle switch), then
+    the own native core, then CPython pow."""
     if exp >= 0 and modulus & 1 and modulus.bit_length() >= _NATIVE_POW_MIN_BITS:
-        impl = _get_native_modexp()
-        if impl:
-            return impl(base, exp, modulus)
+        # FSDKR_NATIVE_POW=0 is the pure-CPython oracle switch and is
+        # read per call; the GMP route does NOT depend on the own core's
+        # build status (gmp.available() is its own gate)
+        if os.environ.get("FSDKR_NATIVE_POW", "1") == "1":
+            from ..native import gmp
+
+            if gmp.available():
+                return gmp.powm(base, exp, modulus)
+            impl = _get_native_modexp()
+            if impl:
+                return impl(base, exp, modulus)
     return pow(base, exp, modulus)
 
 

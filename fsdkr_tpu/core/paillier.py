@@ -22,6 +22,7 @@ __all__ = [
     "EncryptionKey",
     "DecryptionKey",
     "keygen",
+    "keygen_batch",
     "encrypt",
     "encrypt_with_randomness",
     "encrypt_with_randomness_batch",
@@ -62,6 +63,19 @@ class DecryptionKey:
 def keygen(modulus_bits: int) -> tuple[EncryptionKey, DecryptionKey]:
     n, p, q = primes.gen_modulus(modulus_bits)
     return EncryptionKey.from_n(n), DecryptionKey(p=p, q=q)
+
+
+def keygen_batch(
+    modulus_bits: int, count: int
+) -> list[tuple[EncryptionKey, DecryptionKey]]:
+    """`count` fresh keypairs through one batched prime pipeline (the
+    per-sender keygen loop of distribute_batch: candidates sieve, MR,
+    and confirm as FSDKR_THREADS-parallel windows instead of 2*count
+    serial gen_prime loops)."""
+    return [
+        (EncryptionKey.from_n(n), DecryptionKey(p=p, q=q))
+        for n, p, q in primes.gen_moduli_batch(modulus_bits, count)
+    ]
 
 
 def sample_randomness(ek: EncryptionKey) -> int:
@@ -118,18 +132,34 @@ def encrypt(ek: EncryptionKey, m: int) -> int:
 
 def decrypt(dk: DecryptionKey, ek: EncryptionKey, c: int) -> int:
     """CRT decryption: m = L(c^lambda mod n^2) * lambda^{-1} mod n, done
-    separately mod p^2 and q^2 and recombined."""
+    separately mod p^2 and q^2 and recombined. Under FSDKR_CRT each leg
+    runs through the secret-CRT engine's fault-checked path
+    (backend.crt.fault_checked_powm): computed mod p^2*r for a fresh
+    64-bit prime r and re-verified mod r, so a faulted leg aborts
+    (CrtFaultError) instead of producing a wrong plaintext — the decrypt
+    output feeds the refreshed key share, and the Bellcore gcd attack
+    applies to a faulted CRT leg here exactly as it does to RSA-CRT
+    signatures."""
     p, q = dk.p, dk.q
     if p == 0 or q == 0:
         raise ValueError("decryption key has been zeroized")
     n = p * q
     pp, qq = p * p, q * q
+    from ..backend import crt
+
+    if crt.crt_enabled() and math.gcd(c, n) == 1:
+        cp_pow = crt.fault_checked_powm(c % pp, p - 1, pp)
+        cq_pow = crt.fault_checked_powm(c % qq, q - 1, qq)
+    else:  # gate off, or a non-unit ciphertext (decryptable garbage):
+        # the historical unchecked legs
+        cp_pow = intops.mod_pow(c % pp, p - 1, pp)
+        cq_pow = intops.mod_pow(c % qq, q - 1, qq)
     # With g = 1+n: L_p(g^{p-1} mod p^2) = (p-1)*q mod p, so the CRT
     # correction factor is h_p = ((p-1)*q)^{-1} mod p (and symmetrically q).
     hp = pow((p - 1) * q % p, -1, p)
     hq = pow((q - 1) * p % q, -1, q)
-    mp = ((intops.mod_pow(c % pp, p - 1, pp) - 1) // p) * hp % p
-    mq = ((intops.mod_pow(c % qq, q - 1, qq) - 1) // q) * hq % q
+    mp = ((cp_pow - 1) // p) * hp % p
+    mq = ((cq_pow - 1) // q) * hq % q
     # CRT combine
     qinv = pow(q, -1, p)
     diff = (mp - mq) * qinv % p

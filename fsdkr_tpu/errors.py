@@ -124,3 +124,15 @@ class RingPedersenProofValidation(FsDkrError):
     def __init__(self, party_index: int):
         self.party_index = party_index
         super().__init__(f"Ring Pedersen proof failed for party {party_index}")
+
+
+class CrtFaultError(FsDkrError):
+    """A secret-CRT modexp leg failed its Bellcore fault check
+    (backend/crt.py): the recombined value is withheld entirely — a
+    faulted CRT output would let gcd(output - truth, N) recover a prime
+    factor of the prover's key, so the engine aborts hard instead of
+    ever emitting it. No detail beyond the failure itself is exposed
+    (the faulty residues stay inside the engine)."""
+
+    def __init__(self):
+        super().__init__("secret-CRT modexp failed its fault check")

@@ -26,7 +26,9 @@ __all__ = [
     "modexp_shared",
     "multi_modexp_batch",
     "modmul_batch",
+    "crt_modexp_batch",
     "is_probable_prime",
+    "is_probable_prime_batch",
     "widen_limbs",
     "narrow_limbs",
     "thread_count",
@@ -41,7 +43,9 @@ _LIB = _loader.get_lib(
     "_fsdkr_native",
     ("fsdkr_modexp", "fsdkr_modexp_w", "fsdkr_modexp_batch",
      "fsdkr_modexp_batch_w", "fsdkr_modexp_shared", "fsdkr_modexp_shared_w",
-     "fsdkr_multi_modexp_batch", "fsdkr_miller_rabin", "fsdkr_modmul_batch",
+     "fsdkr_multi_modexp_batch", "fsdkr_miller_rabin",
+     "fsdkr_miller_rabin_batch", "fsdkr_modmul_batch",
+     "fsdkr_crt_modexp_batch",
      "fsdkr_comb_table_words", "fsdkr_comb_precompute", "fsdkr_comb_apply",
      "fsdkr_limbs_widen_u16", "fsdkr_limbs_narrow_u16",
      "fsdkr_set_threads", "fsdkr_get_threads"),
@@ -237,6 +241,29 @@ def _comb_window_bits(ebits: int, m_rows: int) -> int:
     return best
 
 
+def _comb_window_bits_cached(ebits: int, m_rows: int, L: int, budget: int) -> int:
+    """Lim-Lee-style width for PERSISTENT comb tables: when the table
+    lives in the bytes-budgeted LRU it is keyed by committee state
+    (h1/h2, N~) and survives across epochs — proactive refresh re-runs
+    on the same committee — so the build amortizes over epochs, not just
+    this call's rows. The width therefore optimizes apply cost with the
+    build discounted by an expected-reuse factor, subject to a per-table
+    byte cap that keeps a full committee's table set (~3-4 tables per
+    receiver: one per exponent width class) resident inside the budget
+    instead of thrashing the LRU."""
+    reuse = 4  # conservative expected epochs per committee
+    cap = max(budget // 48, 1 << 20)
+    best, best_cost = 4, None
+    for w in (4, 5, 6, 7, 8):
+        W = -(-ebits // w)
+        if w > 4 and W * (1 << w) * L * _LIMB_BYTES > cap:
+            continue
+        cost = W * (1.0 + ((1 << w) - 2) / (m_rows * reuse))
+        if best_cost is None or cost < best_cost:
+            best, best_cost = w, cost
+    return best
+
+
 def _cached_comb_table(lib, base_red: int, mod: int, L: int, EL: int, wbits: int):
     """Comb window table for (base, modulus, geometry) from the
     process-wide persistent cache (utils.lru), building and inserting on
@@ -292,7 +319,17 @@ def modexp_shared(
         return [pow(base, e, mod) for e in exps]
     _LIB.sync_threads()
     m_rows = len(exps)
-    wbits = _comb_window_bits(EL * 64, m_rows)
+    if cache:
+        from ..utils.lru import global_cache
+
+        budget = global_cache().budget
+        wbits = (
+            _comb_window_bits_cached(EL * 64, m_rows, L, budget)
+            if budget > 0
+            else _comb_window_bits(EL * 64, m_rows)
+        )
+    else:
+        wbits = _comb_window_bits(EL * 64, m_rows)
     out = (ctypes.c_uint64 * (m_rows * L))()
     exp_buf = _to_buf(list(exps), EL)
     mod_buf = _to_buf([mod], L)
@@ -415,6 +452,82 @@ def multi_modexp_batch(
     res = _from_buf(out_buf, rows, L)
     _wipe_buf(base_buf, exp_buf, mod_buf, out_buf)
     return res
+
+
+def crt_modexp_batch(
+    bases: Sequence[int], exps: Sequence[int], mods: Sequence[int]
+) -> List[int]:
+    """Row-wise bases^exps mod mods for the secret-CRT legs
+    (backend/crt.py): every operand here is secret-derived — the leg
+    modulus p*r itself contains a factor of the prover's key, so ALL
+    four buffers ride the wipe discipline, and Montgomery constants are
+    amortized over runs of equal consecutive moduli (the planner submits
+    legs grouped per CRT context). Falls back to CPython pow when the
+    native core is unavailable or a leg modulus is even/oversized —
+    bit-identical either way."""
+    if not bases:
+        return []
+    if not (len(bases) == len(exps) == len(mods)):
+        raise ValueError("batch length mismatch")
+    lib = _get()
+    _LIB.sync_threads()
+    L = max(_limbs_for(m) for m in mods)
+    if (
+        lib is None
+        or L > _MAX_LIMBS
+        or any(m % 2 == 0 or m <= 1 for m in mods)
+        or any(e < 0 for e in exps)
+    ):
+        return [pow(b, e, m) for b, e, m in zip(bases, exps, mods)]
+    EL = max(1, max(_limbs_for(e) for e in exps))
+    rows = len(bases)
+    out = (ctypes.c_uint64 * (rows * L))()
+    base_buf = _to_buf([b % m for b, m in zip(bases, mods)], L)
+    exp_buf = _to_buf(list(exps), EL)
+    mod_buf = _to_buf(list(mods), L)
+    rc = lib.fsdkr_crt_modexp_batch(
+        base_buf, exp_buf, mod_buf, out, rows, L, EL,
+        _gen_window_bits(max(e.bit_length() for e in exps)),
+    )
+    if rc != 0:
+        _wipe_buf(base_buf, exp_buf, mod_buf, out)
+        return [pow(b, e, m) for b, e, m in zip(bases, exps, mods)]
+    res = _from_buf(out, rows, L)
+    _wipe_buf(base_buf, exp_buf, mod_buf, out)
+    return res
+
+
+def is_probable_prime_batch(
+    ns: Sequence[int], rounds: int = 30
+) -> Optional[List[bool]]:
+    """Miller-Rabin over a batch of candidates with CSPRNG witnesses,
+    candidates split across the FSDKR_THREADS row pool (the prime-
+    generation shape: one native call per sieve window instead of one
+    per candidate). Returns None when the native path cannot handle the
+    inputs — the caller falls back to per-candidate testing."""
+    if not ns:
+        return []
+    lib = _get()
+    L = max(_limbs_for(n) for n in ns)
+    if (
+        lib is None
+        or L > _MAX_LIMBS
+        or any(n < 5 or n % 2 == 0 for n in ns)
+    ):
+        return None
+    _LIB.sync_threads()
+    rows = len(ns)
+    witnesses = [
+        2 + secrets.randbelow(n - 3) for n in ns for _ in range(rounds)
+    ]
+    verdicts = (ctypes.c_int * rows)()
+    n_buf = _to_buf(list(ns), L)  # prime candidates: secret key material
+    wit_buf = _to_buf(witnesses, L)
+    rc = lib.fsdkr_miller_rabin_batch(n_buf, wit_buf, verdicts, rows, L, rounds)
+    _wipe_buf(n_buf, wit_buf)
+    if rc != 0:
+        return None
+    return [bool(v) for v in verdicts]
 
 
 def modmul_batch(

@@ -1,0 +1,277 @@
+"""ctypes bridge to the system GMP — the reference's own bigint backend.
+
+The reference's host bignum layer IS GMP: `/root/reference/Cargo.toml:42-44`
+selects curv/kzen-paillier's GMP backend, so every prover modexp of the
+original fs-dkr runs through `mpz_powm`. This container ships
+`libgmp.so.10`; binding it closes most of the remaining gap between the
+rebuild's host path and the reference's (measured on this box, 2048-bit
+exponent mod a 4096-bit n^2: own CIOS core 20.9 ms, `mpz_powm` 10.7 ms,
+CPython pow 101 ms). The own Montgomery core (csrc/fsdkr_native.cpp)
+remains the fallback and the engine for the comb / joint-ladder /
+Miller-Rabin shapes GMP has no amortized entry for.
+
+Routing: `FSDKR_GMP` (default on) gates this bridge; `backend.powm`'s
+host engine and the secret-CRT legs (backend/crt.py) prefer it when
+available. The CRT legs — whose exponents are factorization-derived —
+use `mpz_powm_sec` (GMP's constant-time ladder, designed for exactly
+this: secret exponents over odd moduli); everything else uses the plain
+`mpz_powm` and inherits the documented variable-time host residual
+(SECURITY.md).
+
+Wipe discipline: mpz operands created here expose their limb pointer
+(`_mp_d`), which is zeroed with memset before `mpz_clear` whenever the
+value was secret. GMP's INTERNAL powm scratch cannot be wiped from
+outside — a documented residual of the same class as the CIOS core's
+inner temporaries (SECURITY.md "known residuals").
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import os
+import threading
+from typing import List, Optional, Sequence
+
+__all__ = [
+    "available", "enabled", "powm", "powm_batch", "gcd", "PublicOperand",
+]
+
+
+class _mpz_t(ctypes.Structure):
+    # GMP's public __mpz_struct ABI (gmp.h): {int _mp_alloc; int _mp_size;
+    # mp_limb_t *_mp_d} with 64-bit limbs on every platform this repo
+    # targets (x86-64 / aarch64 glibc).
+    _fields_ = [
+        ("_mp_alloc", ctypes.c_int),
+        ("_mp_size", ctypes.c_int),
+        ("_mp_d", ctypes.POINTER(ctypes.c_uint64)),
+    ]
+
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+_LOCK = threading.Lock()
+
+
+def enabled() -> bool:
+    """FSDKR_GMP gate (default on): =0 removes the GMP route everywhere,
+    reverting host modexp to the own native core for A/B isolation and
+    for exercising the fallback engines in CI."""
+    return os.environ.get("FSDKR_GMP", "1").lower() not in (
+        "0", "off", "false", "no",
+    )
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    for name in ("gmp", "gmp.10"):
+        path = ctypes.util.find_library(name)
+        if path:
+            try:
+                return ctypes.CDLL(path)
+            except OSError:
+                continue
+    for soname in ("libgmp.so.10", "libgmp.so"):
+        try:
+            return ctypes.CDLL(soname)
+        except OSError:
+            continue
+    return None
+
+
+def _get() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if not _TRIED:
+        with _LOCK:
+            if not _TRIED:
+                lib = _load()
+                if lib is not None:
+                    try:
+                        P = ctypes.POINTER(_mpz_t)
+                        lib.__gmpz_init.argtypes = [P]
+                        lib.__gmpz_clear.argtypes = [P]
+                        lib.__gmpz_import.argtypes = [
+                            P, ctypes.c_size_t, ctypes.c_int, ctypes.c_size_t,
+                            ctypes.c_int, ctypes.c_size_t, ctypes.c_void_p,
+                        ]
+                        lib.__gmpz_export.argtypes = [
+                            ctypes.c_void_p, ctypes.POINTER(ctypes.c_size_t),
+                            ctypes.c_int, ctypes.c_size_t, ctypes.c_int,
+                            ctypes.c_size_t, P,
+                        ]
+                        lib.__gmpz_export.restype = ctypes.c_void_p
+                        lib.__gmpz_powm.argtypes = [P, P, P, P]
+                        lib.__gmpz_powm_sec.argtypes = [P, P, P, P]
+                        lib.__gmpz_gcd.argtypes = [P, P, P]
+                        lib.__gmpz_tdiv_r.argtypes = [P, P, P]
+                    except AttributeError:
+                        lib = None
+                _LIB = lib
+                _TRIED = True
+    return _LIB
+
+
+def available() -> bool:
+    return enabled() and _get() is not None
+
+
+def _to_mpz(lib, x: int) -> _mpz_t:
+    z = _mpz_t()
+    lib.__gmpz_init(ctypes.byref(z))
+    nb = (x.bit_length() + 7) // 8 or 1
+    buf = bytearray(x.to_bytes(nb, "little"))
+    lib.__gmpz_import(
+        ctypes.byref(z), nb, -1, 1, 0, 0,
+        (ctypes.c_char * nb).from_buffer(buf),
+    )
+    buf[:] = bytes(nb)  # wipe the staging copy in place
+    return z
+
+
+def _from_mpz(lib, z: _mpz_t) -> int:
+    size = abs(z._mp_size)
+    if size == 0:
+        return 0
+    buf = ctypes.create_string_buffer(size * 8)
+    cnt = ctypes.c_size_t()
+    lib.__gmpz_export(buf, ctypes.byref(cnt), -1, 1, 0, 0, ctypes.byref(z))
+    out = int.from_bytes(buf.raw[: cnt.value], "little")
+    ctypes.memset(buf, 0, len(buf))
+    return out
+
+
+def _clear(lib, *zs: _mpz_t) -> None:
+    """Zero the mpz limb storage (the only heap copy GMP lets us reach),
+    then free it — the bridge leg of the wipe discipline."""
+    for z in zs:
+        if z._mp_d and z._mp_alloc > 0:
+            ctypes.memset(z._mp_d, 0, z._mp_alloc * 8)
+        lib.__gmpz_clear(ctypes.byref(z))
+
+
+def powm(base: int, exp: int, mod: int, secret: bool = False) -> int:
+    """base^exp mod mod via mpz_powm (secret=True: mpz_powm_sec, GMP's
+    constant-time ladder — requires exp > 0 and mod odd, which every
+    secret-CRT leg satisfies; other shapes silently take the plain
+    route). Falls back to CPython pow when GMP is unavailable, the
+    exponent is negative (mpz_powm raises a process-fatal divide-by-zero
+    on non-invertible bases — pow's ValueError is the contract callers
+    expect), or the modulus is out of domain."""
+    lib = _get() if enabled() else None
+    if lib is None or exp < 0 or mod <= 0:
+        return pow(base, exp, mod)
+    zb = _to_mpz(lib, base % mod)
+    ze = _to_mpz(lib, exp)
+    zm = _to_mpz(lib, mod)
+    zr = _to_mpz(lib, 0)
+    if secret and exp > 0 and mod % 2 == 1:
+        lib.__gmpz_powm_sec(
+            ctypes.byref(zr), ctypes.byref(zb), ctypes.byref(ze),
+            ctypes.byref(zm),
+        )
+    else:
+        lib.__gmpz_powm(
+            ctypes.byref(zr), ctypes.byref(zb), ctypes.byref(ze),
+            ctypes.byref(zm),
+        )
+    res = _from_mpz(lib, zr)
+    _clear(lib, zb, ze, zm, zr)
+    return res
+
+
+def powm_batch(
+    bases: Sequence[int],
+    exps: Sequence[int],
+    mods: Sequence[int],
+    secret: bool = False,
+) -> List[int]:
+    """Row-wise bases^exps mod mods through mpz_powm(_sec). ctypes
+    releases the GIL around each GMP call, so rows split across a Python
+    thread pool sized by FSDKR_THREADS (0/auto = cores) — the same knob
+    and bit-identity contract as the native row pool (rows are
+    independent; per-row math is untouched by the split)."""
+    if not bases:
+        return []
+    if not (len(bases) == len(exps) == len(mods)):
+        raise ValueError("batch length mismatch")
+    lib = _get() if enabled() else None
+    if lib is None:
+        return [pow(b, e, m) for b, e, m in zip(bases, exps, mods)]
+    rows = len(bases)
+    nt = _pool_threads()
+    if nt > 1 and rows > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        nt = min(nt, rows)
+        spans = [
+            (i * rows // nt, (i + 1) * rows // nt) for i in range(nt)
+        ]
+        with ThreadPoolExecutor(max_workers=nt) as ex:
+            parts = list(
+                ex.map(
+                    lambda s: [
+                        powm(bases[i], exps[i], mods[i], secret)
+                        for i in range(s[0], s[1])
+                    ],
+                    spans,
+                )
+            )
+        return [v for part in parts for v in part]
+    return [powm(b, e, m, secret) for b, e, m in zip(bases, exps, mods)]
+
+
+class PublicOperand:
+    """A PUBLIC integer imported into mpz form once and reused across
+    calls (the prime sieve's ~94kbit primorial would otherwise pay a
+    ~12 KB import per gcd). Only for public values: the held limbs are
+    never wiped."""
+
+    def __init__(self, x: int):
+        self.value = abs(x)
+        self._z: Optional[_mpz_t] = None
+
+    def _mpz(self, lib) -> _mpz_t:
+        if self._z is None:
+            self._z = _to_mpz(lib, self.value)
+        return self._z
+
+
+def gcd(a: int, b) -> int:
+    """gcd via mpz_gcd (GMP's subquadratic HGCD — CPython's Euclid costs
+    ~0.2 ms against the prime-generation sieve's primorial, GMP ~0.02 ms
+    once the big public operand is cached as a PublicOperand). Secret
+    operand limbs are wiped before free (prime candidates are secret)."""
+    lib = _get() if enabled() else None
+    if lib is None:
+        import math
+
+        return math.gcd(a, b.value if isinstance(b, PublicOperand) else b)
+    za = _to_mpz(lib, abs(a))
+    zr = _to_mpz(lib, 0)
+    if isinstance(b, PublicOperand):
+        # fold the big cached operand down to |a| first with one GMP
+        # division (mpz_gcd's own first step, but without its per-call
+        # working copy of the 94kbit operand), then gcd the small pair:
+        # ~3x the straight mpz_gcd at the sieve shape
+        zb = b._mpz(lib)
+        lib.__gmpz_tdiv_r(ctypes.byref(zr), ctypes.byref(zb), ctypes.byref(za))
+        lib.__gmpz_gcd(ctypes.byref(zr), ctypes.byref(za), ctypes.byref(zr))
+        res = _from_mpz(lib, zr)
+        _clear(lib, za, zr)  # zb is cached and public: not cleared
+        return res
+    zb = _to_mpz(lib, abs(b))
+    lib.__gmpz_gcd(ctypes.byref(zr), ctypes.byref(za), ctypes.byref(zb))
+    res = _from_mpz(lib, zr)
+    _clear(lib, za, zb, zr)
+    return res
+
+
+def _pool_threads() -> int:
+    val = os.environ.get("FSDKR_THREADS", "0").strip().lower() or "0"
+    try:
+        n = int(val)
+    except ValueError:
+        n = 0  # auto
+    if n <= 0:
+        n = os.cpu_count() or 1
+    return n

@@ -98,6 +98,9 @@ class AliceProof:
             avals=avals, rvals=rvals, alpha=alpha, beta=beta, gamma=gamma,
             rho=rho, ntv=ntv, nv=nv, nnv=nnv, hash_alg=hash_alg, joint=joint,
         )
+        # CONTRACT: the beta^n mod n^2 column is LAST in either layout —
+        # distribute_batch splits it into the fused Paillier launch (its
+        # own sub-phase trace) by position.
         if joint:
             # z/w as joint multi-exponentiation rows (see
             # PDLwSlackProof.prove_stage1): the mod_mul_col recombination

@@ -80,10 +80,15 @@ class NiCorrectKeyProof:
         hash_alg: str | None = None,
     ) -> List["NiCorrectKeyProof"]:
         """All provers' N-th-root columns in ONE modexp launch (the
-        cross-sender batch axis of a refresh, SURVEY.md §1)."""
-        if powm is None:
-            from ..backend.powm import host_powm as powm
-        bases, exps, mods = [], [], []
+        cross-sender batch axis of a refresh, SURVEY.md §1). The prover
+        owns every row's factorization (d = N^{-1} mod phi exists only
+        because it does), so the column rides the secret-CRT planner
+        route (backend.powm.crt_powm, FSDKR_CRT): d reduced mod p-1/q-1
+        halves both the exponent and the limb width per fault-checked
+        leg; =0 keeps the full-width `powm` path bit-identically."""
+        from ..backend.powm import crt_powm
+
+        bases, exps, mods, factors = [], [], [], []
         for dk in dks:
             n = dk.p * dk.q
             phi = (dk.p - 1) * (dk.q - 1)
@@ -91,7 +96,8 @@ class NiCorrectKeyProof:
             bases += [_derive_rho(n, salt, i, hash_alg) for i in range(rounds)]
             exps += [d] * rounds
             mods += [n] * rounds
-        sigma = powm(bases, exps, mods)
+            factors += [(dk.p, dk.q)] * rounds
+        sigma = crt_powm(bases, exps, mods, factors, powm)
         return [
             NiCorrectKeyProof(sigma_vec=sigma[k * rounds : (k + 1) * rounds])
             for k in range(len(dks))

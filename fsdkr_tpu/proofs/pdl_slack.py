@@ -116,7 +116,9 @@ class PDLwSlackProof:
         h2^gamma per row) — the planner routes the shared h1/h2 terms
         through the comb and recombines in-launch, so the host
         mod_mul_col columns disappear; =0 keeps the per-term column
-        layout."""
+        layout. CONTRACT: the beta^n mod n^2 column is LAST in either
+        layout — distribute_batch splits it into the fused Paillier
+        launch (its own sub-phase trace) by position."""
         q = CURVE_ORDER
         q3 = q**3
         alpha = [secrets.randbelow(q3) for _ in ntv]

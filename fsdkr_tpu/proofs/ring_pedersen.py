@@ -42,16 +42,44 @@ class RingPedersenStatement:
     ) -> tuple["RingPedersenStatement", "RingPedersenWitness"]:
         """Fresh modulus; T = r^2 mod N, S = T^lambda mod N
         (reference `src/ring_pedersen_proof.rs:48-74`)."""
-        n, p, q = primes.gen_modulus(config.paillier_bits)
-        phi = (p - 1) * (q - 1)
-        r = secrets.randbelow(n)
-        lam = secrets.randbelow(phi)
-        t = pow(r, 2, n)
-        s = pow(t, lam, n)
-        return (
-            RingPedersenStatement(S=s, T=t, N=n, ek=EncryptionKey.from_n(n)),
-            RingPedersenWitness(p=p, q=q, lam=lam, phi=phi),
-        )
+        return RingPedersenStatement.generate_batch(1, config)[0]
+
+    @staticmethod
+    def generate_batch(
+        count: int, config: ProtocolConfig = DEFAULT_CONFIG
+    ) -> list:
+        """`count` fresh statements: moduli through the batched prime
+        pipeline (core.primes, FSDKR_THREADS windows), and S = T^lambda
+        through the secret-CRT engine (FSDKR_CRT, backend.crt) — the
+        prover owns this factorization, so the full-width ladder
+        decomposes into two fault-checked half-width legs with lambda
+        reduced mod p-1 / q-1. Bit-identical to the full-width path
+        (same sampling order, same values; pinned by tests/test_crt.py)."""
+        from ..backend import crt
+
+        moduli = primes.gen_moduli_batch(config.paillier_bits, count)
+        use_crt = crt.crt_enabled()
+        out = []
+        for n, p, q in moduli:
+            phi = (p - 1) * (q - 1)
+            r = secrets.randbelow(n)
+            lam = secrets.randbelow(phi)
+            t = pow(r, 2, n)
+            if use_crt:
+                s = crt.crt_modexp_batch(
+                    [t], [lam], [crt.get_context(n, p, q)]
+                )[0]
+            else:
+                s = pow(t, lam, n)
+            out.append(
+                (
+                    RingPedersenStatement(
+                        S=s, T=t, N=n, ek=EncryptionKey.from_n(n)
+                    ),
+                    RingPedersenWitness(p=p, q=q, lam=lam, phi=phi),
+                )
+            )
+        return out
 
 
 @dataclass(frozen=True)
@@ -108,11 +136,27 @@ class RingPedersenProof:
             [secrets.randbelow(w.phi) for _ in range(m_security)]
             for w in witnesses
         ]
-        A_all = powm(
-            [st.T for st in statements for _ in range(m_security)],
-            [a for grp in a_all for a in grp],
-            [st.N for st in statements for _ in range(m_security)],
-        )
+        from ..backend import crt
+
+        if crt.crt_enabled():
+            # The prover owns each statement's factorization: the M=256
+            # commitment rows T^{a_i} mod N decompose into two
+            # fault-checked HALF-width fixed-base comb legs per prover
+            # (exponents reduced mod p-1/q-1, one squaring ladder per
+            # leg amortized over all M rows, tables built-used-wiped —
+            # secret-derived, never cached). ~4x the full-width comb;
+            # A values bit-identical (tests/test_crt.py).
+            A_all = []
+            for w, st, a_vec in zip(witnesses, statements, a_all):
+                A_all += crt.crt_powm_shared(
+                    st.T, a_vec, crt.get_context(st.N, w.p, w.q)
+                )
+        else:
+            A_all = powm(
+                [st.T for st in statements for _ in range(m_security)],
+                [a for grp in a_all for a in grp],
+                [st.N for st in statements for _ in range(m_security)],
+            )
         out = []
         for k, (witness, a_vec) in enumerate(zip(witnesses, a_all)):
             A_vec = A_all[k * m_security : (k + 1) * m_security]

@@ -210,17 +210,44 @@ class RefreshMessage:
         ]
 
         with phase("distribute.prove_stage1", items=len(flat_rand)):
-            pdl_state, pdl_cols = PDLwSlackProof.prove_stage1(
-                flat_witnesses, flat_h1, flat_h2, flat_nt, flat_nv, flat_nnv,
-                hash_alg=config.hash_alg,
-            )
-            alice_state, alice_cols = AliceProof.generate_stage1(
-                flat_share_ints, flat_rand, flat_h1, flat_h2, flat_nt,
-                flat_nv, flat_nnv, hash_alg=config.hash_alg,
-            )
+            # sub-phase traces (BENCH_r06 put this whole block at 20.5 s
+            # with no internal split): nonce sampling, the Paillier
+            # r^n/beta^n wall, and the mod-N~ commitment columns are
+            # separately attributable. Both provers return their
+            # Paillier beta^n column LAST (documented contract of
+            # prove_stage1/generate_stage1), so the full-width public-
+            # exponent columns (enc r^n + both beta^n — one width class)
+            # stay fused in one launch set, and the h1/h2 joint columns
+            # keep their cross-family comb groups in the other.
+            with phase("distribute.stage1.sample", items=len(flat_rand)):
+                pdl_state, pdl_cols = PDLwSlackProof.prove_stage1(
+                    flat_witnesses, flat_h1, flat_h2, flat_nt, flat_nv,
+                    flat_nnv, hash_alg=config.hash_alg,
+                )
+                alice_state, alice_cols = AliceProof.generate_stage1(
+                    flat_share_ints, flat_rand, flat_h1, flat_h2, flat_nt,
+                    flat_nv, flat_nnv, hash_alg=config.hash_alg,
+                )
             enc_col = (flat_rand, flat_nv, flat_nnv)  # r^n mod n^2
-            res1 = powm_columns(powm, enc_col, *pdl_cols, *alice_cols)
+            with phase(
+                "distribute.stage1.enc_beta_pow", items=3 * len(flat_rand)
+            ):
+                res_pail = powm_columns(
+                    powm, enc_col, pdl_cols[-1], alice_cols[-1]
+                )
+            with phase(
+                "distribute.stage1.commit_pow",
+                items=(len(pdl_cols) + len(alice_cols) - 2) * len(flat_rand),
+            ):
+                res_commit = powm_columns(
+                    powm, *pdl_cols[:-1], *alice_cols[:-1]
+                )
             n_pdl = len(pdl_cols)
+            res1 = (
+                [res_pail[0]]
+                + res_commit[: n_pdl - 1] + [res_pail[1]]
+                + res_commit[n_pdl - 1 :] + [res_pail[2]]
+            )
             pdl_res1 = res1[1 : 1 + n_pdl]
             alice_res1 = res1[1 + n_pdl : 1 + n_pdl + len(alice_cols)]
 
@@ -267,12 +294,15 @@ class RefreshMessage:
                 alice_state, res2[len(pdl_cols2) :]
             )
 
-        # ---- per-sender keygens (host-serial, native Miller-Rabin) and
-        # fused correct-key / ring-Pedersen prover columns
+        # ---- per-sender keygens (batched prime pipeline: candidate
+        # windows through the FSDKR_THREADS-parallel Miller-Rabin batch
+        # instead of 2 serial gen_prime loops per sender) and fused
+        # correct-key / ring-Pedersen prover columns (secret-CRT engine
+        # under FSDKR_CRT)
         with phase("distribute.keygen", items=len(per)):
-            ek_dk = [paillier.keygen(config.paillier_bits) for _ in per]
+            ek_dk = paillier.keygen_batch(config.paillier_bits, len(per))
         with phase("distribute.ring_pedersen_gen", items=len(per)):
-            rp = [RingPedersenStatement.generate(config) for _ in per]
+            rp = RingPedersenStatement.generate_batch(len(per), config)
         with phase("distribute.correct_key_prove", items=len(per)):
             ck_proofs = NiCorrectKeyProof.proof_batch(
                 [dk for _, dk in ek_dk], rounds=config.correct_key_rounds,

@@ -16,10 +16,13 @@ geometry. Exponents, shares, nonces, and anything else covered by the
 wipe discipline (`wipe_array`/`_wipe_buf`/`secure_wipe`) must never be
 inserted; secret-base callers keep the one-shot wiped paths.
 
-Budget: FSDKR_CACHE_BUDGET_MB megabytes (default 256; 0 disables
+Budget: FSDKR_CACHE_BUDGET_MB megabytes (default 512; 0 disables
 caching entirely). Overflow evicts least-recently-used entries one at a
 time — never the whole cache (the old `_CTX_CACHE.clear()` behavior
-flushed hot contexts mid-run).
+flushed hot contexts mid-run). The default doubled in round 8 so a full
+n=16 committee's Lim-Lee comb set (4 width classes x 16 receivers at
+the widened persistent-table windows, ~370 MB) stays resident across
+epochs instead of thrashing.
 """
 
 from __future__ import annotations
@@ -101,9 +104,9 @@ _GLOBAL_LOCK = threading.Lock()
 
 def _budget_bytes() -> int:
     try:
-        mb = float(os.environ.get("FSDKR_CACHE_BUDGET_MB", "256"))
+        mb = float(os.environ.get("FSDKR_CACHE_BUDGET_MB", "512"))
     except ValueError:
-        mb = 256.0
+        mb = 512.0
     return int(mb * (1 << 20))
 
 

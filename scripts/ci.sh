@@ -47,4 +47,14 @@ FSDKR_RLC=0 python -m pytest tests/test_rlc.py tests/test_tamper.py \
   tests/test_join_tamper.py tests/test_tpu_backend.py -q \
   -m "not slow and not heavy" -p no:cacheprovider
 
+echo "== test: FSDKR_CRT=0 + FSDKR_GMP=0 leg (full-width prover path) =="
+# the smoke tier above ran with the default FSDKR_CRT=1 (secret-CRT
+# prover engine) and the GMP bridge active where present; this leg
+# forces the full-width prover path AND the own native engines on the
+# prover-facing suites so neither fallback can rot unexercised (same
+# pattern as the FSDKR_RLC=0 leg)
+FSDKR_CRT=0 FSDKR_GMP=0 python -m pytest tests/test_crt.py \
+  tests/test_proofs.py tests/test_native.py tests/test_thread_parity.py \
+  -q -m "not slow and not heavy" -p no:cacheprovider
+
 echo "== ci.sh: all gates green =="

@@ -157,6 +157,15 @@ run n16_nomultiexp 2400 FSDKR_MULTIEXP=0 FSDKR_TRACE=1 python bench.py
 # {rlc_groups, rows_folded, bisect_fallbacks, fullwidth_ladders} as the
 # bench JSON's "rlc" field)
 run n16_norlc 2400 FSDKR_RLC=0 FSDKR_TRACE=1 python bench.py
+# secret-CRT prover engine A/B (FSDKR_CRT: =0 reverts the ring-Pedersen
+# / correct-key / Paillier-decrypt provers to full-width modexp; =1 is
+# the default — the nominal n16 step above measures it and emits the
+# "crt" stats block plus the per-phase prover deltas in
+# trace_distribute / trace_distribute_warm and distribute_warm_s; this
+# step is the off arm at the same n=16 full-2048-bit shape, mirroring
+# the n16_norlc pattern). The CPU-platform acceptance pair is
+# bench_results/crt_ab_n16_{on,off}.json.
+run n16_nocrt 2400 FSDKR_CRT=0 FSDKR_TRACE=1 python bench.py
 
 # host-engine thread scaling (FSDKR_THREADS row pool; 1 = the historical
 # serial loop, auto = all cores). Pinned to the CPU platform + host

@@ -1,0 +1,418 @@
+"""Secret-CRT prover engine (FSDKR_CRT, backend/crt.py) + GMP bridge.
+
+Pins the four contracts of the CRT tentpole:
+- PARITY: the decomposition is an arithmetic identity — proofs and
+  transcripts are bit-identical between FSDKR_CRT=0 and =1 for every
+  prover entry point (ring-Pedersen gen+prove, correct-key, Paillier
+  decrypt), including Garner edge cases (adjacent primes, unbalanced
+  leg widths).
+- FAULT CHECK: a corrupted CRT leg aborts with CrtFaultError before any
+  recombined value is emitted (Bellcore/BDL: a faulted output would
+  leak a factor through one gcd).
+- SECRET-STORE ISOLATION: factorization-derived integers (p, q, leg
+  orders, the Garner coefficient) never appear in the public precompute
+  LRU — they live only in the per-session secret store.
+- ENGINE EQUIVALENCE: the GMP bridge (native/gmp.py) agrees with
+  CPython pow on every edge shape, and all engines agree at any
+  FSDKR_THREADS setting (thread additions live in test_thread_parity).
+
+This file must stay green with FSDKR_CRT=0 and/or FSDKR_GMP=0 forced
+from the environment (scripts/ci.sh runs that leg): tests pin their own
+gate values via monkeypatch.
+"""
+
+import math
+import random
+import secrets as _secrets
+
+import pytest
+
+from fsdkr_tpu import native
+from fsdkr_tpu.backend import crt
+from fsdkr_tpu.backend.powm import crt_powm
+from fsdkr_tpu.core import paillier, primes
+from fsdkr_tpu.errors import CrtFaultError
+from fsdkr_tpu.native import gmp
+
+RNG = random.Random(0xC127)
+
+
+class _SeededSecrets:
+    """Deterministic stand-in for a proof module's `secrets` import, so
+    the FSDKR_CRT=0/1 arms sample identical nonces and the proof bytes
+    can be compared bit-for-bit."""
+
+    def __init__(self, seed):
+        self._rng = random.Random(seed)
+
+    def randbelow(self, bound):
+        return self._rng.randrange(bound)
+
+    def randbits(self, k):
+        return self._rng.getrandbits(k)
+
+
+def _modulus(bits=512):
+    return primes.gen_modulus(bits)
+
+
+def _next_prime(x):
+    c = x + 2
+    while not primes.is_probable_prime(c, 16):
+        c += 2
+    return c
+
+
+# ---------------------------------------------------------------------------
+# engine parity
+
+
+def test_crt_modexp_parity_plain_and_square():
+    n, p, q = _modulus(512)
+    ctx = crt.get_context(n, p, q)
+    ctx2 = crt.get_context(n * n, p, q)
+    bs = [RNG.randrange(1, n) | 1 for _ in range(5)]
+    es = [RNG.getrandbits(w) for w in (1, 64, 511, 700, 0)]
+    assert crt.crt_modexp_batch(bs, es, [ctx] * 5) == [
+        pow(b, e, n) for b, e in zip(bs, es)
+    ]
+    bs2 = [RNG.randrange(1, n * n) | 1 for _ in range(3)]
+    es2 = [RNG.getrandbits(1024) for _ in range(3)]
+    assert crt.crt_modexp_batch(bs2, es2, [ctx2] * 3) == [
+        pow(b, e, n * n) for b, e in zip(bs2, es2)
+    ]
+
+
+def test_crt_garner_adjacent_primes():
+    # p ~ q (consecutive primes): Garner's (xp - xq) * qinv mod p leg is
+    # maximally collision-prone here; must stay exact
+    p = primes.gen_prime(256)
+    q = _next_prime(p)
+    n = p * q
+    ctx = crt.get_context(n, p, q)
+    bs = [RNG.randrange(2, n) for _ in range(4)]
+    es = [RNG.getrandbits(512) for _ in range(4)]
+    got = crt.crt_modexp_batch(bs, es, [ctx] * 4)
+    assert got == [pow(b, e, n) for b, e in zip(bs, es)]
+
+
+def test_crt_garner_unbalanced_widths():
+    # one 192-bit and one 640-bit factor: leg limb widths differ 3x
+    p = primes.gen_prime(192)
+    q = primes.gen_prime(640)
+    n = p * q
+    ctx = crt.get_context(n, p, q)
+    b = RNG.randrange(2, n)
+    e = RNG.getrandbits(832)
+    assert crt.crt_modexp_batch([b], [e], [ctx]) == [pow(b, e, n)]
+    ctx2 = crt.get_context(n * n, p, q)
+    assert crt.crt_modexp_batch([b], [e], [ctx2]) == [pow(b, e, n * n)]
+
+
+def test_crt_context_rejects_bad_factorizations():
+    p = primes.gen_prime(128)
+    q = primes.gen_prime(128)
+    with pytest.raises(ValueError):
+        crt.CrtContext(p * p, p, p)  # p == q: no CRT split exists
+    with pytest.raises(ValueError):
+        crt.CrtContext(p * q + 2, p, q)  # not the product
+    with pytest.raises(ValueError):
+        crt.CrtContext((p * q) ** 2 + 1, p, q)
+
+
+def test_crt_non_unit_base_falls_back_exactly():
+    n, p, q = _modulus(384)
+    ctx = crt.get_context(n, p, q)
+    rows = [(p, 17), (2 * q, 33), (RNG.randrange(2, n) | 1, 129)]
+    got = crt.crt_modexp_batch(
+        [b for b, _ in rows], [e for _, e in rows], [ctx] * 3
+    )
+    assert got == [pow(b, e, n) for b, e in rows]
+
+
+def test_crt_powm_planner_route(monkeypatch):
+    n, p, q = _modulus(384)
+    bs = [RNG.randrange(2, n) for _ in range(4)]
+    es = [RNG.getrandbits(384) for _ in range(4)]
+    want = [pow(b, e, n) for b, e in zip(bs, es)]
+    monkeypatch.setenv("FSDKR_CRT", "1")
+    assert crt_powm(bs, es, [n] * 4, [(p, q), None, (p, q), None]) == want
+    monkeypatch.setenv("FSDKR_CRT", "0")
+    assert crt_powm(bs, es, [n] * 4, [(p, q)] * 4) == want
+
+
+def test_crt_powm_shared_parity():
+    n, p, q = _modulus(512)
+    ctx = crt.get_context(n, p, q)
+    base = pow(RNG.randrange(2, n), 2, n)
+    exps = [0, 1, (p - 1) * (q - 1) - 1] + [
+        RNG.getrandbits(512) for _ in range(8)
+    ]
+    assert crt.crt_powm_shared(base, exps, ctx) == [
+        pow(base, e, n) for e in exps
+    ]
+
+
+# ---------------------------------------------------------------------------
+# prover entry points: bit-identical FSDKR_CRT=0 vs =1
+
+
+def test_ring_pedersen_prove_bit_identical(monkeypatch):
+    from fsdkr_tpu.proofs import ring_pedersen as rp_mod
+    from fsdkr_tpu.proofs.ring_pedersen import (
+        RingPedersenProof,
+        RingPedersenStatement,
+        RingPedersenWitness,
+    )
+    from fsdkr_tpu.core.paillier import EncryptionKey
+
+    stmts, wits = [], []
+    for n, p, q in primes.gen_moduli_batch(512, 2):
+        phi = (p - 1) * (q - 1)
+        lam = RNG.randrange(phi)
+        t = pow(RNG.randrange(2, n), 2, n)
+        stmts.append(
+            RingPedersenStatement(
+                S=pow(t, lam, n), T=t, N=n, ek=EncryptionKey.from_n(n)
+            )
+        )
+        wits.append(RingPedersenWitness(p=p, q=q, lam=lam, phi=phi))
+
+    arms = {}
+    for gate in ("1", "0"):
+        monkeypatch.setenv("FSDKR_CRT", gate)
+        monkeypatch.setattr(rp_mod, "secrets", _SeededSecrets(0xABCD))
+        arms[gate] = RingPedersenProof.prove_batch(wits, stmts, 16)
+    monkeypatch.setattr(rp_mod, "secrets", _secrets)
+    assert [(pf.A, pf.Z) for pf in arms["1"]] == [
+        (pf.A, pf.Z) for pf in arms["0"]
+    ]
+    for pf, st in zip(arms["1"], stmts):
+        pf.verify(st, 16)
+
+
+def test_ring_pedersen_generate_crt_verifies(monkeypatch):
+    from fsdkr_tpu.config import TEST_CONFIG
+    from fsdkr_tpu.proofs.ring_pedersen import (
+        RingPedersenProof,
+        RingPedersenStatement,
+    )
+
+    monkeypatch.setenv("FSDKR_CRT", "1")
+    st, w = RingPedersenStatement.generate_batch(1, TEST_CONFIG)[0]
+    assert st.S == pow(st.T, w.lam, st.N)  # CRT-computed S is exact
+    proof = RingPedersenProof.prove(w, st, 16)
+    proof.verify(st, 16)
+
+
+def test_correct_key_bit_identical(monkeypatch):
+    # the correct-key prover is deterministic given dk (Fiat-Shamir
+    # bases, fixed exponent d): the two arms must agree byte-for-byte
+    from fsdkr_tpu.proofs.correct_key import NiCorrectKeyProof
+
+    n, p, q = _modulus(768)
+    dk = paillier.DecryptionKey(p=p, q=q)
+    ek = paillier.EncryptionKey.from_n(n)
+    arms = {}
+    for gate in ("1", "0"):
+        monkeypatch.setenv("FSDKR_CRT", gate)
+        arms[gate] = NiCorrectKeyProof.proof_batch([dk], rounds=3)[0]
+    assert arms["1"].sigma_vec == arms["0"].sigma_vec
+    assert arms["1"].verify(ek, rounds=3)
+
+
+def test_paillier_decrypt_bit_identical(monkeypatch):
+    ek, dk = paillier.keygen(768)
+    m = RNG.randrange(1 << 64)
+    c = paillier.encrypt(ek, m)
+    outs = {}
+    for gate in ("1", "0"):
+        monkeypatch.setenv("FSDKR_CRT", gate)
+        outs[gate] = paillier.decrypt(dk, ek, c)
+    assert outs["1"] == outs["0"] == m
+
+
+# ---------------------------------------------------------------------------
+# fault trip wire: a corrupted leg ABORTS, never emits a value
+
+
+def _corrupting(fn, bump_row):
+    def wrapped(bases, exps, mods):
+        out = fn(bases, exps, mods)
+        out[bump_row] = (out[bump_row] + 1) % mods[bump_row]
+        return out
+
+    return wrapped
+
+
+def test_fault_check_trips_on_corrupted_leg(monkeypatch):
+    n, p, q = _modulus(384)
+    ctx = crt.get_context(n, p, q)
+    bs = [RNG.randrange(2, n) | 1 for _ in range(3)]
+    es = [RNG.getrandbits(384) for _ in range(3)]
+    real = crt._leg_powm
+    for bad_leg in (0, 4):  # a p-leg and a q-leg
+        monkeypatch.setattr(crt, "_leg_powm", _corrupting(real, bad_leg))
+        with pytest.raises(CrtFaultError):
+            crt.crt_modexp_batch(bs, es, [ctx] * 3)
+    monkeypatch.setattr(crt, "_leg_powm", real)
+
+
+def test_fault_check_trips_in_shared_and_single(monkeypatch):
+    n, p, q = _modulus(384)
+    ctx = crt.get_context(n, p, q)
+
+    real = native.modexp_shared
+
+    def corrupted_shared(base, exps, mod, cache=True):
+        out = real(base, exps, mod, cache=cache)
+        out[1] = (out[1] + 1) % mod
+        return out
+
+    monkeypatch.setattr(native, "modexp_shared", corrupted_shared)
+    with pytest.raises(CrtFaultError):
+        crt.crt_powm_shared(
+            pow(RNG.randrange(2, n), 2, n),
+            [RNG.getrandbits(256) for _ in range(4)],
+            ctx,
+        )
+    monkeypatch.undo()
+
+    monkeypatch.setattr(crt, "_leg_powm", _corrupting(crt._leg_powm, 0))
+    with pytest.raises(CrtFaultError):
+        crt.fault_checked_powm(RNG.randrange(2, p) | 1, p - 1, p * p)
+
+
+def test_faulted_decrypt_aborts_not_wrong(monkeypatch):
+    ek, dk = paillier.keygen(768)
+    c = paillier.encrypt(ek, 42)
+    monkeypatch.setenv("FSDKR_CRT", "1")
+    monkeypatch.setattr(crt, "_leg_powm", _corrupting(crt._leg_powm, 0))
+    with pytest.raises(CrtFaultError):
+        paillier.decrypt(dk, ek, c)
+
+
+# ---------------------------------------------------------------------------
+# secret store: bounded, wiped, and isolated from the public LRU
+
+
+def test_secret_store_isolation_from_public_lru(monkeypatch):
+    from fsdkr_tpu.utils import lru
+
+    monkeypatch.setenv("FSDKR_CRT", "1")
+    lru.clear_caches()
+    crt.clear_store()
+
+    n, p, q = _modulus(512)
+    ctx = crt.get_context(n, p, q)
+    secret_ints = {
+        p, q, ctx.d_p, ctx.d_q, ctx.qinv, p * p, q * q,
+        p - 1, q - 1, p * (p - 1), q * (q - 1),
+    }
+    # run every CRT path, plus a cacheable PUBLIC comb for contrast
+    crt.crt_modexp_batch(
+        [RNG.randrange(2, n) | 1], [RNG.getrandbits(512)], [ctx]
+    )
+    crt.crt_powm_shared(
+        pow(RNG.randrange(2, n), 2, n),
+        [RNG.getrandbits(512) for _ in range(4)],
+        ctx,
+    )
+    crt.fault_checked_powm(RNG.randrange(2, p) | 1, p - 1, p * p)
+    native.modexp_shared(3, [RNG.getrandbits(256) for _ in range(4)], n)
+
+    cache = lru.global_cache()
+    seen_public_comb = False
+    for key in list(cache._d.keys()):
+        for part in key:
+            assert not (
+                isinstance(part, int) and part in secret_ints
+            ), f"secret-derived integer leaked into public LRU key {key!r}"
+        if key[0] == "native-comb":
+            seen_public_comb = True
+    assert seen_public_comb  # the public path DID cache, isolation is real
+
+    assert crt.store_stats()["entries"] >= 1
+    crt.clear_store()
+    assert crt.store_stats()["entries"] == 0
+    assert ctx.p_leg == 0 and ctx.qinv == 0  # wiped, not just dropped
+
+
+def test_check_prime_helper():
+    assert crt._is_prime64((1 << 61) - 1)
+    assert not crt._is_prime64((1 << 62) - 1)
+    r = crt._fresh_check_prime([123456789])
+    assert r.bit_length() == 64 and crt._is_prime64(r)
+
+
+# ---------------------------------------------------------------------------
+# GMP bridge: edge parity with CPython pow
+
+
+@pytest.mark.skipif(not gmp.available(), reason="GMP bridge unavailable")
+def test_gmp_powm_edges():
+    m = RNG.getrandbits(512) | (1 << 511) | 1
+    b, e = RNG.randrange(m), RNG.getrandbits(512)
+    assert gmp.powm(b, e, m) == pow(b, e, m)
+    assert gmp.powm(b, e, m, secret=True) == pow(b, e, m)
+    assert gmp.powm(b, 0, m) == 1
+    assert gmp.powm(0, 5, m) == 0
+    assert gmp.powm(b, e, 2 * m) == pow(b, e, 2 * m)  # even modulus
+    assert gmp.powm(5, 3, 1) == 0
+    # negative exponent rides the pow fallback: EXACT behavior parity —
+    # same inverse when it exists, same ValueError when it does not
+    # (b is a random draw, so both outcomes occur across runs)
+    try:
+        want_inv = pow(b, -1, m)
+    except ValueError:
+        with pytest.raises(ValueError):
+            gmp.powm(b, -1, m)
+    else:
+        assert gmp.powm(b, -1, m) == want_inv
+    assert gmp.powm(3, -1, 65537) == pow(3, -1, 65537)
+    assert gmp.powm_batch([b, 0, b], [e, 7, 1], [m, m, m]) == [
+        pow(b, e, m), 0, b % m,
+    ]
+
+
+@pytest.mark.skipif(not gmp.available(), reason="GMP bridge unavailable")
+def test_gmp_gcd_and_cached_operand():
+    wide = primes._wide_primorial()
+    op = gmp.PublicOperand(wide)
+    for _ in range(20):
+        c = RNG.getrandbits(256) | 1
+        assert gmp.gcd(c, op) == math.gcd(c, wide)
+        assert gmp.gcd(c, wide) == math.gcd(c, wide)
+
+
+def test_gmp_gate_off(monkeypatch):
+    monkeypatch.setenv("FSDKR_GMP", "0")
+    assert not gmp.available()
+    m = RNG.getrandbits(256) | 1
+    assert gmp.powm(3, 5, m) == pow(3, 5, m)  # pure fallback still exact
+
+
+# ---------------------------------------------------------------------------
+# batched prime pipeline
+
+
+def test_gen_primes_batch_shape_and_primality():
+    ps = primes.gen_primes_batch(192, 3)
+    assert len(ps) == 3
+    for p in ps:
+        assert p.bit_length() == 192
+        assert (p >> 190) == 0b11  # top two bits forced
+        assert primes.is_probable_prime(p, 20)
+
+
+def test_gen_moduli_batch():
+    for n, p, q in primes.gen_moduli_batch(384, 2):
+        assert n == p * q and p != q and n.bit_length() == 384
+
+
+def test_native_mr_batch_agrees_with_oracle():
+    cases = [2**89 - 1, 561, (2**61 - 1) * (2**31 - 1), 2**107 - 1]
+    got = native.is_probable_prime_batch(cases, 16)
+    if got is None:
+        pytest.skip("native core unavailable")
+    assert got == [True, False, False, True]

@@ -236,6 +236,101 @@ def test_concurrent_callers(monkeypatch):
         assert res == [pow(b, e, m) for b, e, m in zip(bs, es, mods)]
 
 
+def test_crt_modexp_batch_parity(monkeypatch):
+    # the secret-CRT leg batch (run-grouped Montgomery constants): the
+    # thread split must not disturb run boundaries' math
+    shared = _odd_mod(512)
+    mods = [shared] * 6 + [_odd_mod(512) for _ in range(5)]
+    bs = [RNG.getrandbits(512) for _ in mods]
+    es = [RNG.getrandbits(500) for _ in mods]
+    serial, pooled = _both_thread_counts(
+        monkeypatch, lambda: native.crt_modexp_batch(bs, es, mods)
+    )
+    assert serial == pooled == [pow(b, e, m) for b, e, m in zip(bs, es, mods)]
+
+
+def test_miller_rabin_batch_parity(monkeypatch):
+    cases = [2**521 - 1, (2**127 - 1) * (2**89 - 1), 561, _odd_mod(512)]
+    serial, pooled = _both_thread_counts(
+        monkeypatch, lambda: native.is_probable_prime_batch(cases, 16)
+    )
+    # witnesses are CSPRNG-fresh per call; 16 rounds make the verdicts
+    # deterministic in practice for these inputs
+    assert serial == pooled
+
+
+def test_gmp_powm_batch_parity(monkeypatch):
+    from fsdkr_tpu.native import gmp
+
+    if not gmp.available():
+        pytest.skip("GMP bridge unavailable")
+    mods = [_odd_mod(512) for _ in range(9)]
+    bs = [RNG.getrandbits(512) for _ in mods]
+    es = [RNG.getrandbits(384) for _ in mods]
+    for secret in (False, True):
+        serial, pooled = _both_thread_counts(
+            monkeypatch, lambda: gmp.powm_batch(bs, es, mods, secret=secret)
+        )
+        assert serial == pooled == [
+            pow(b, e, m) for b, e, m in zip(bs, es, mods)
+        ]
+
+
+def test_prover_phase_parity(monkeypatch):
+    """The CRT-routed prover phases (PR 2 loose end: pin the prover side
+    before a multicore host measures it): ring-Pedersen prove, correct-
+    key, and the batched keygen MR pipeline must be bit-identical (or
+    verdict-identical where witnesses are CSPRNG-fresh) at 1 vs 8
+    threads."""
+    import random as _random
+
+    from fsdkr_tpu.core import paillier, primes
+    from fsdkr_tpu.proofs import ring_pedersen as rp_mod
+    from fsdkr_tpu.proofs.correct_key import NiCorrectKeyProof
+    from fsdkr_tpu.proofs.ring_pedersen import (
+        RingPedersenProof,
+        RingPedersenStatement,
+        RingPedersenWitness,
+    )
+
+    monkeypatch.setenv("FSDKR_CRT", "1")
+    n, p, q = primes.gen_modulus(512)
+    phi = (p - 1) * (q - 1)
+    lam = RNG.randrange(phi)
+    t = pow(RNG.randrange(2, n), 2, n)
+    st = RingPedersenStatement(
+        S=pow(t, lam, n), T=t, N=n,
+        ek=paillier.EncryptionKey.from_n(n),
+    )
+    wit = RingPedersenWitness(p=p, q=q, lam=lam, phi=phi)
+    dk = paillier.DecryptionKey(p=p, q=q)
+
+    class _Seeded:
+        def __init__(self):
+            self._rng = _random.Random(0x5EED)
+
+        def randbelow(self, bound):
+            return self._rng.randrange(bound)
+
+    def run():
+        monkeypatch.setattr(rp_mod, "secrets", _Seeded())
+        proofs = RingPedersenProof.prove_batch([wit], [st], 8)
+        ck = NiCorrectKeyProof.proof_batch([dk], rounds=3)
+        return [(pf.A, pf.Z) for pf in proofs], ck[0].sigma_vec
+
+    serial, pooled = _both_thread_counts(monkeypatch, run)
+    assert serial == pooled
+
+    # keygen MR pipeline: verdict parity over a fixed candidate set
+    cands = [primes.gen_prime(128) for _ in range(2)] + [
+        _odd_mod(128) * _odd_mod(128) for _ in range(2)
+    ]
+    serial, pooled = _both_thread_counts(
+        monkeypatch, lambda: primes._mr_batch(cands, 16)
+    )
+    assert serial == pooled == [True, True, False, False]
+
+
 def test_planner_thread_parity(monkeypatch):
     """multi_powm (host engines) end-to-end at both thread settings:
     comb-routed terms, joint rows, generic loners, negative exponents."""
