@@ -148,6 +148,20 @@ class ProtocolConfig:
     def prime_bits(self) -> int:
         return self.paillier_bits // 2
 
+    @property
+    def key_material_pool_key(self) -> Tuple[int, int, int, str]:
+        """Pool key of the precompute key-material pool
+        (fsdkr_tpu/precompute, FSDKR_PRECOMPUTE): everything a pooled
+        (ek, dk, correct-key proof, ring-Pedersen statement+proof)
+        bundle depends on — sessions with different parameters can never
+        consume each other's key material."""
+        return (
+            self.paillier_bits,
+            self.m_security,
+            self.correct_key_rounds,
+            self.hash_alg,
+        )
+
 
 DEFAULT_CONFIG = ProtocolConfig()
 

@@ -17,7 +17,27 @@ __all__ = [
     "gen_primes_batch",
     "gen_modulus",
     "gen_moduli_batch",
+    "gen_stats",
+    "gen_stats_reset",
 ]
+
+# Generation-work counters (bench.py's keygen-anomaly pin): prime search
+# is a randomized algorithm with geometric-tail work, so wall-clock
+# comparisons between two keygen runs are meaningless without
+# normalizing by the work actually drawn — candidates sieved and
+# Miller-Rabin rounds requested. Counting is unsynchronized-increment
+# (the generation pipeline is driven from one thread per batch; a torn
+# read would only perturb a diagnostic).
+_GEN_STATS = {"candidates": 0, "mr_rounds": 0}
+
+
+def gen_stats() -> dict:
+    return dict(_GEN_STATS)
+
+
+def gen_stats_reset() -> None:
+    for k in _GEN_STATS:
+        _GEN_STATS[k] = 0
 
 # Product of odd primes below 4000 — one gcd against a candidate rejects
 # nearly all composites before any modexp is spent on Miller-Rabin.
@@ -183,12 +203,15 @@ def gen_primes_batch(bits: int, count: int) -> list:
             )
             if gmp.gcd(c, sieve) == 1:
                 cands.append(c)
+        _GEN_STATS["candidates"] += len(cands)
         # one cheap round first: almost every sieved composite dies here
         pre = _mr_batch(cands, 1)
+        _GEN_STATS["mr_rounds"] += len(cands)
         survivors = [c for c, v in zip(cands, pre) if v]
         if not survivors:
             continue
         conf = _mr_batch(survivors, 29)
+        _GEN_STATS["mr_rounds"] += 29 * len(survivors)
         found += [c for c, v in zip(survivors, conf) if v]
     return found[:count]
 

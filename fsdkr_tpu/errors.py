@@ -126,6 +126,18 @@ class RingPedersenProofValidation(FsDkrError):
         super().__init__(f"Ring Pedersen proof failed for party {party_index}")
 
 
+class PrecomputeReuseError(FsDkrError):
+    """A precompute pool entry was consumed twice (fsdkr_tpu/precompute).
+    Entries are strictly single-use: a Paillier randomizer or sigma
+    first-message nonce that enters two transcripts collapses the
+    zero-knowledge property (two challenges over one commitment reveal
+    the witness), so the second take aborts hard instead of returning
+    the wiped value."""
+
+    def __init__(self):
+        super().__init__("precompute pool entry consumed twice (single-use)")
+
+
 class CrtFaultError(FsDkrError):
     """A secret-CRT modexp leg failed its Bellcore fault check
     (backend/crt.py): the recombined value is withheld entirely — a

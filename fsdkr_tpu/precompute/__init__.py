@@ -1,0 +1,33 @@
+"""Offline/online split for distribute(): input-independent precompute
+pools + background producer (FSDKR_PRECOMPUTE, default on).
+
+`pools` holds the bounded single-use secret store and its hygiene rules;
+`producer` holds the per-kind constructors, the committee target
+registry, and the background fill thread. See SECURITY.md "Precompute
+pool discipline" for what is and is not poolable.
+"""
+
+from .pools import (  # noqa: F401
+    PoolEntry,
+    PrecomputeStore,
+    clear_pools,
+    enabled,
+    get_store,
+    key_material_pool_key,
+    precompute_stats,
+    put,
+    stats_reset,
+    take,
+)
+from .producer import (  # noqa: F401
+    background_enabled,
+    clear_targets,
+    committee_targets,
+    kick,
+    prefill,
+    produce_for,
+    producer_running,
+    register_committee,
+    register_targets,
+    stop_background,
+)

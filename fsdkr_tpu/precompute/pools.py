@@ -1,0 +1,318 @@
+"""Bounded, secret-hygienic precompute pools (FSDKR_PRECOMPUTE).
+
+Round-8 traces put almost all of a warm `distribute()` in work that does
+not depend on the epoch's inputs: Paillier randomizer powers r^n mod n^2
+and the sigma-protocol beta^n columns (8.2 s), the mod-N~ first-message
+commitments (2.2 s of the commit wall), and fresh key material with its
+proofs (~5.5 s of keygen + ring-Pedersen gen + correct-key / rp proving).
+This module is the offline half of the classic MPC offline/online split:
+pools of single-use entries produced ahead of the refresh round (by the
+background producer in `producer.py`, riding the same batch engines) and
+consumed by `distribute()` at each phase boundary, with per-row inline
+fallback when a pool runs dry — the consumed values are bit-identical to
+what the inline path would have sampled and computed, so transcripts do
+not depend on the gate (pinned by tests/test_precompute.py).
+
+## Pool kinds
+
+- ("enc", n): Paillier encryption randomizers for receiver modulus n —
+  entries (r, r^n mod n^2) with r drawn exactly like
+  `paillier.sample_randomness`.
+- ("pdl", (h1, h2, N~, n)) and ("alice", (h1, h2, N~, n)): sigma
+  first-messages for one receiver environment — entries
+  (alpha, beta, rho, gamma, beta^n mod n^2, h2^rho mod N~,
+  h1^alpha*h2^gamma mod N~), i.e. the prover's round-1 state plus every
+  input-independent power. The witness-dependent factor h1^x stays
+  online; the Fiat-Shamir challenge binds the commitments only AFTER
+  the (online) statement is fixed, so nothing challenge-derived is ever
+  poolable (SECURITY.md "Precompute pool discipline").
+- ("keys", (paillier_bits, m_security, correct_key_rounds, hash_alg)):
+  complete key-material bundles (ek, dk, NiCorrectKeyProof,
+  RingPedersenStatement, RingPedersenProof) — both proofs are functions
+  of the fresh key alone, so the whole block is offline.
+
+## Secret hygiene
+
+Every entry is secret material (randomizers, nonces, decryption keys).
+Entries live ONLY in this module's in-process store — never the public
+precompute LRU (`utils/lru.py`), whose entries persist unwiped under
+the public-value-only rule (pinned by tests/test_precompute.py).
+Entries are STRICTLY single-use: `PoolEntry.take()` returns the values
+once, drops the references (the Python-int wipe discipline,
+SECURITY.md), and raises `PrecomputeReuseError` forever after — a
+reused sigma nonce answers two challenges and reveals the witness.
+`clear_pools()` wipes every unconsumed entry (session teardown).
+
+Pool KEYS are broadcast-public values (receiver moduli, ring-Pedersen
+bases, config parameters); only entry VALUES are secret.
+
+FSDKR_PRECOMPUTE=0 reverts every consumer to the inline path; the
+bounded budget is FSDKR_POOL_DEPTH entries per (kind, key) under an
+FSDKR_POOL_BUDGET_MB total byte cap.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Tuple
+
+from ..errors import PrecomputeReuseError
+
+__all__ = [
+    "enabled",
+    "PoolEntry",
+    "PrecomputeStore",
+    "get_store",
+    "take",
+    "put",
+    "clear_pools",
+    "precompute_stats",
+    "stats_reset",
+    "key_material_pool_key",
+]
+
+
+def enabled() -> bool:
+    """FSDKR_PRECOMPUTE gates the whole offline/online split (default
+    on). Read at call time so the bench battery and the ci.sh leg can
+    toggle it per step; =0 makes every consumer inline and every
+    producer a no-op."""
+    return os.environ.get("FSDKR_PRECOMPUTE", "1").lower() not in (
+        "0", "off", "false", "no",
+    )
+
+
+def _pool_depth() -> int:
+    """Per-(kind, key) entry cap (default 64: four n=16 epochs ahead)."""
+    try:
+        return max(1, int(os.environ.get("FSDKR_POOL_DEPTH", "64")))
+    except ValueError:
+        return 64
+
+
+def _pool_budget_bytes() -> int:
+    try:
+        mb = float(os.environ.get("FSDKR_POOL_BUDGET_MB", "64"))
+    except ValueError:
+        mb = 64.0
+    return int(mb * (1 << 20))
+
+
+def _nbytes(v) -> int:
+    """Byte estimate of an entry value for the pool budget: ints by bit
+    length, containers and proof/statement objects by their int fields."""
+    if isinstance(v, int):
+        return v.bit_length() // 8 + 1
+    if isinstance(v, (list, tuple)):
+        return sum(_nbytes(x) for x in v)
+    d = getattr(v, "__dict__", None)
+    if d:
+        return sum(_nbytes(x) for x in d.values())
+    slots = getattr(type(v), "__slots__", None)
+    if slots:
+        return sum(_nbytes(getattr(v, s, 0)) for s in slots)
+    return 64
+
+
+class PoolEntry:
+    """One single-use pooled value set. `take()` returns the values
+    exactly once and drops the internal references; any further take
+    raises PrecomputeReuseError (see errors.py for why reuse is a
+    zero-knowledge break, not just a bug)."""
+
+    __slots__ = ("_values", "nbytes")
+
+    def __init__(self, values: tuple):
+        self._values = tuple(values)
+        self.nbytes = _nbytes(self._values)
+
+    def take(self) -> tuple:
+        if self._values is None:
+            raise PrecomputeReuseError()
+        v = self._values
+        self._values = None  # int-level wipe: drop the only pool refs
+        return v
+
+    def wipe(self) -> None:
+        self._values = None
+
+
+class PrecomputeStore:
+    """Per-session store of pools keyed by (kind, key). Bounded by
+    per-key depth and a total byte budget; FIFO within a pool so
+    consumption order matches production order (the seeded-parity
+    contract). Thread-safe: the background producer puts while
+    distribute() takes."""
+
+    def __init__(self):
+        self._pools: Dict[Tuple, deque] = OrderedDict()
+        self._lock = threading.RLock()
+        self._bytes = 0
+        self.stats = {
+            "produced": 0,
+            "consumed": 0,
+            "dry_fallbacks": 0,
+            "wiped": 0,
+            "bytes_pooled": 0,
+        }
+
+    # -- consumption ----------------------------------------------------
+    def take(self, kind: str, key) -> Optional[tuple]:
+        """Pop and consume the oldest entry of pool (kind, key); None
+        (counted as a dry fallback) when the pool is dry — the caller
+        then computes inline, bit-identically."""
+        with self._lock:
+            pool = self._pools.get((kind, key))
+            if not pool:
+                self.stats["dry_fallbacks"] += 1
+                return None
+            ent = pool.popleft()
+            self._bytes -= ent.nbytes
+            self.stats["consumed"] += 1
+            self.stats["bytes_pooled"] = self._bytes
+        return ent.take()
+
+    # -- production -----------------------------------------------------
+    def put(self, kind: str, key, values: tuple) -> bool:
+        """Append one entry; False (entry wiped, not stored) when the
+        per-key depth or the total byte budget is exhausted."""
+        ent = PoolEntry(values)
+        with self._lock:
+            pool = self._pools.setdefault((kind, key), deque())
+            if (
+                len(pool) >= _pool_depth()
+                or self._bytes + ent.nbytes > _pool_budget_bytes()
+            ):
+                ent.wipe()
+                self.stats["wiped"] += 1
+                return False
+            pool.append(ent)
+            self._bytes += ent.nbytes
+            self.stats["produced"] += 1
+            self.stats["bytes_pooled"] = self._bytes
+            return True
+
+    def depth(self, kind: str, key) -> int:
+        with self._lock:
+            pool = self._pools.get((kind, key))
+            return len(pool) if pool else 0
+
+    def room(self, kind: str, key, want: int) -> int:
+        """How many entries pool (kind, key) can still absorb toward a
+        target of `want` (producer scheduling)."""
+        with self._lock:
+            have = self.depth(kind, key)
+            return max(0, min(want, _pool_depth()) - have)
+
+    # -- teardown / accounting ------------------------------------------
+    def drop(self, kind: str, key) -> None:
+        """Wipe and remove one whole pool (target retirement: refresh
+        rotates receiver moduli every epoch, so pools keyed by retired
+        moduli hold never-again-consumable secrets)."""
+        with self._lock:
+            pool = self._pools.pop((kind, key), None)
+            if not pool:
+                return
+            for ent in pool:
+                self._bytes -= ent.nbytes
+                ent.wipe()
+                self.stats["wiped"] += 1
+            pool.clear()
+            self.stats["bytes_pooled"] = self._bytes
+
+    def clear(self) -> None:
+        """Wipe every unconsumed entry (session teardown, tests, A/B)."""
+        with self._lock:
+            for pool in self._pools.values():
+                for ent in pool:
+                    ent.wipe()
+                    self.stats["wiped"] += 1
+                pool.clear()
+            self._pools.clear()
+            self._bytes = 0
+            self.stats["bytes_pooled"] = 0
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                **self.stats,
+                "entries": sum(len(p) for p in self._pools.values()),
+                "pools": len(self._pools),
+            }
+
+    def stats_reset(self) -> None:
+        with self._lock:
+            for k in self.stats:
+                self.stats[k] = 0
+            self.stats["bytes_pooled"] = self._bytes
+
+    def secret_values(self) -> List[int]:
+        """Every int currently pooled, recursing into proof/statement/
+        key objects like _nbytes does — the key-material bundles hold
+        their secrets (dk.p, dk.q, proof fields) inside objects, and the
+        LRU-isolation suite must see those too, not just the bare-int
+        entries (tests: asserts none of these ever appears in the
+        public cache)."""
+        out: List[int] = []
+
+        def walk(v):
+            if isinstance(v, bool) or v is None:
+                return
+            if isinstance(v, int):
+                out.append(v)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    walk(x)
+            else:
+                d = getattr(v, "__dict__", None)
+                if d:
+                    for x in d.values():
+                        walk(x)
+                else:
+                    for s in getattr(type(v), "__slots__", ()):
+                        walk(getattr(v, s, None))
+
+        with self._lock:
+            for pool in self._pools.values():
+                for ent in pool:
+                    if ent._values is not None:
+                        walk(ent._values)
+        return out
+
+
+_STORE = PrecomputeStore()
+
+
+def get_store() -> PrecomputeStore:
+    return _STORE
+
+
+def take(kind: str, key) -> Optional[tuple]:
+    return _STORE.take(kind, key)
+
+
+def put(kind: str, key, values: tuple) -> bool:
+    return _STORE.put(kind, key, values)
+
+
+def clear_pools() -> None:
+    _STORE.clear()
+
+
+def precompute_stats() -> Dict[str, int]:
+    return _STORE.snapshot()
+
+
+def stats_reset() -> None:
+    _STORE.stats_reset()
+
+
+def key_material_pool_key(config) -> tuple:
+    """Pool key of the key-material pool — delegates to
+    ProtocolConfig.key_material_pool_key so producer-side and
+    consumer-side keys can never drift apart (a silent divergence would
+    let sessions with different parameters consume each other's key
+    material, exactly what the key exists to prevent)."""
+    return config.key_material_pool_key

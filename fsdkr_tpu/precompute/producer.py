@@ -1,0 +1,280 @@
+"""Producers for the precompute pools: offline constructors + the
+background fill thread (the producer half of the offline/online split;
+the pool store and hygiene rules live in `pools.py`).
+
+Production rides the SAME batch engines as the inline path (the
+backend/powm host route — GMP/native Montgomery with their
+FSDKR_THREADS row pools and wipe discipline), so offline+online total
+work equals the inline cost plus pool bookkeeping. The background
+thread (`utils.pipeline.BackgroundProducer`) produces in small bounded
+steps whenever targets registered by `distribute()` are under depth;
+`collect()` kicks it on entry, so production overlaps the verifier's
+GIL-releasing native launches — the SZKP-style producer/consumer
+decoupling that keeps the modexp engines saturated between rounds.
+
+Targets are metadata only (pool kind + PUBLIC key + desired depth); the
+secret entries themselves go straight into the pool store. Registration
+happens at the end of `distribute_batch` (it knows the committee), via
+`register_committee` for serving systems, or implicitly through
+`prefill` (the synchronous one-shot used by bench.py's offline
+measurement and the tests).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Tuple
+
+from . import pools
+
+__all__ = [
+    "background_enabled",
+    "produce_enc",
+    "produce_keys",
+    "produce_for",
+    "register_targets",
+    "register_committee",
+    "prefill",
+    "kick",
+    "stop_background",
+    "producer_running",
+    "clear_targets",
+]
+
+# production step caps: one background step stays bounded (and stop()
+# responsive) while still amortizing the batch engines' launch overhead
+_PAIR_BATCH = 16
+_KEY_BATCH = 2
+
+
+def background_enabled() -> bool:
+    """FSDKR_PRECOMPUTE_BG gates the background producer thread only
+    (default on); =0 keeps the pools purely prefill-driven — bench.py
+    forces =0 around its measured sections so the offline/online A/B is
+    not contaminated by concurrent production on the same cores."""
+    return pools.enabled() and os.environ.get(
+        "FSDKR_PRECOMPUTE_BG", "1"
+    ).lower() not in ("0", "off", "false", "no")
+
+
+# ---------------------------------------------------------------------------
+# per-kind constructors
+
+
+def produce_enc(n: int, count: int, powm=None) -> List[tuple]:
+    """`count` Paillier randomizer entries (r, r^n mod n^2) for receiver
+    modulus n — r drawn exactly like paillier.sample_randomness (the
+    seeded-parity contract), the power through the batched host engines."""
+    from ..core import intops
+
+    if powm is None:
+        from ..backend.powm import host_powm as powm
+    rs = [intops.sample_unit(n) for _ in range(count)]
+    rn = powm(rs, [n] * count, [n * n] * count)
+    return list(zip(rs, rn))
+
+
+def produce_keys(params: tuple, count: int) -> List[tuple]:
+    """`count` complete key-material bundles (ek, dk, NiCorrectKeyProof,
+    RingPedersenStatement, RingPedersenProof) for pool key
+    (paillier_bits, m_security, correct_key_rounds, hash_alg) — the
+    exact call sequence of distribute_batch's four key phases, so seeded
+    runs produce identical material. Ring-Pedersen witnesses are
+    reference-dropped as soon as their proofs exist (the pooled bundle
+    never carries phi/lambda)."""
+    bits, m_security, ck_rounds, hash_alg = params
+    from ..core import paillier
+    from ..proofs.correct_key import NiCorrectKeyProof
+    from ..proofs.ring_pedersen import RingPedersenProof, RingPedersenStatement
+    from ..config import ProtocolConfig
+
+    cfg = ProtocolConfig(
+        paillier_bits=bits, m_security=m_security,
+        correct_key_rounds=ck_rounds, hash_alg=hash_alg,
+    )
+    ek_dk = paillier.keygen_batch(bits, count)
+    rp = RingPedersenStatement.generate_batch(count, cfg)
+    ck_proofs = NiCorrectKeyProof.proof_batch(
+        [dk for _, dk in ek_dk], rounds=ck_rounds, hash_alg=hash_alg
+    )
+    rp_proofs = RingPedersenProof.prove_batch(
+        [w for _, w in rp], [st for st, _ in rp], m_security,
+        None, hash_alg,
+    )
+    out = [
+        (ek, dk, ck, st_w[0], rp_p)
+        for (ek, dk), ck, st_w, rp_p in zip(ek_dk, ck_proofs, rp, rp_proofs)
+    ]
+    rp.clear()  # drop the ring-Pedersen witnesses (phi/lambda) now
+    return out
+
+
+def produce_for(kind: str, key, count: int) -> int:
+    """Produce and pool up to `count` entries of (kind, key); returns
+    how many the pool absorbed. Keys are self-describing: every value
+    production needs is in the (public) pool key."""
+    if count <= 0:
+        return 0
+    if kind == "enc":
+        entries = produce_enc(key, count)
+    elif kind == "pdl":
+        from ..proofs.pdl_slack import PDLwSlackProof
+
+        h1, h2, nt, n = key
+        entries = PDLwSlackProof.produce_stage1(h1, h2, nt, n, count)
+    elif kind == "alice":
+        from ..proofs.alice_range import AliceProof
+
+        h1, h2, nt, n = key
+        entries = AliceProof.produce_stage1(h1, h2, nt, n, count)
+    elif kind == "keys":
+        entries = produce_keys(key, count)
+    else:
+        raise ValueError(f"unknown pool kind {kind!r}")
+    stored = 0
+    for e in entries:
+        if pools.put(kind, key, e):
+            stored += 1
+    return stored
+
+
+# ---------------------------------------------------------------------------
+# target registry + background thread
+
+# (kind, key) -> (want, generation of the registering call). One
+# register_targets call = one generation; a key not re-registered for
+# _TARGET_TTL_GENS calls is retired together with its pool — refresh
+# rotates every sender's Paillier modulus each epoch, so yesterday's
+# enc/pdl/alice pools can never be consumed again and must not hold
+# secret entries (or byte budget) until process teardown. The TTL is
+# generous enough that several interleaved committees re-registering
+# every epoch each keep their keys alive.
+_TARGETS: Dict[Tuple[str, object], Tuple[int, int]] = {}
+_TARGETS_LOCK = threading.Lock()
+_TARGET_GEN = 0
+_TARGET_TTL_GENS = 16
+_PRODUCER = None  # lazily built BackgroundProducer
+
+
+def register_targets(targets) -> None:
+    """Record desired pool depths: targets = [(kind, key, want)] —
+    re-registering refreshes a key's generation and want; keys not
+    re-registered for _TARGET_TTL_GENS calls are retired and their
+    pools wiped. clear_targets() forgets everything at once."""
+    global _TARGET_GEN
+    stale = []
+    with _TARGETS_LOCK:
+        _TARGET_GEN += 1
+        for kind, key, want in targets:
+            _TARGETS[(kind, key)] = (int(want), _TARGET_GEN)
+        for k, (_want, gen) in list(_TARGETS.items()):
+            if gen <= _TARGET_GEN - _TARGET_TTL_GENS:
+                del _TARGETS[k]
+                stale.append(k)
+    store = pools.get_store()
+    for kind, key in stale:
+        store.drop(kind, key)
+
+
+def committee_targets(local_key, new_n: int, senders: int, config) -> list:
+    """Target list for one committee: `senders` entries per receiver
+    pool (every sender consumes one entry per receiver per epoch) and
+    `senders` key bundles — one epoch ahead of steady-state demand."""
+    out = []
+    for i in range(new_n):
+        ek = local_key.paillier_key_vec[i]
+        d = local_key.h1_h2_n_tilde_vec[i]
+        env = (d.g, d.ni, d.N, ek.n)
+        out.append(("enc", ek.n, senders))
+        out.append(("pdl", env, senders))
+        out.append(("alice", env, senders))
+    out.append(("keys", pools.key_material_pool_key(config), senders))
+    return out
+
+
+def register_committee(local_key, new_n: int, senders: int, config) -> None:
+    register_targets(committee_targets(local_key, new_n, senders, config))
+
+
+def clear_targets() -> None:
+    with _TARGETS_LOCK:
+        _TARGETS.clear()
+
+
+def _deficits() -> List[Tuple[str, object, int]]:
+    store = pools.get_store()
+    with _TARGETS_LOCK:
+        items = list(_TARGETS.items())
+    out = []
+    for (kind, key), (want, _gen) in items:
+        room = store.room(kind, key, want)
+        if room > 0:
+            out.append((kind, key, room))
+    return out
+
+
+def _step() -> bool:
+    """One bounded background production step: fill the first deficit
+    that actually absorbs entries, a small batch at a time. Returns
+    False when every target is at depth OR nothing can be stored (the
+    byte budget is the binding constraint: depth-based room alone would
+    report work forever while every put is wiped, and the loop would
+    busy-spin producing discarded key material) — the producer then
+    parks until the next kick."""
+    if not background_enabled():
+        return False
+    for kind, key, room in _deficits():
+        cap = _KEY_BATCH if kind == "keys" else _PAIR_BATCH
+        if produce_for(kind, key, min(room, cap)) > 0:
+            return True
+    return False
+
+
+def _producer():
+    global _PRODUCER
+    if _PRODUCER is None:
+        from ..utils.pipeline import BackgroundProducer
+
+        _PRODUCER = BackgroundProducer(_step)
+    return _PRODUCER
+
+
+def kick() -> None:
+    """Wake (starting if needed) the background producer — called at the
+    end of distribute_batch (targets just registered) and on entry to
+    collect/collect_sessions (idle-time overlap with verification's
+    GIL-releasing launches). No-op when gated off or target-free."""
+    if not background_enabled():
+        return
+    with _TARGETS_LOCK:
+        if not _TARGETS:
+            return
+    _producer().kick()
+
+
+def stop_background(timeout: float = 5.0) -> None:
+    if _PRODUCER is not None:
+        _PRODUCER.stop(timeout=timeout)
+
+
+def producer_running() -> bool:
+    return _PRODUCER is not None and _PRODUCER.running()
+
+
+def prefill(local_key, new_n: int, senders: int, config) -> int:
+    """Synchronous offline fill: bring every pool of this committee up
+    to one epoch of depth and return the number of entries produced.
+    This is the `precompute_offline_s` measurement target in bench.py
+    and the deterministic fill used by the seeded-parity tests."""
+    if not pools.enabled():
+        return 0
+    targets = committee_targets(local_key, new_n, senders, config)
+    register_targets(targets)
+    store = pools.get_store()
+    produced = 0
+    for kind, key, want in targets:
+        room = store.room(kind, key, want)
+        if room > 0:
+            produced += produce_for(kind, key, room)
+    return produced

@@ -78,46 +78,137 @@ class AliceProof:
     # fuse both families' same-width columns into shared launches.
 
     @staticmethod
-    def generate_stage1(
-        avals, rvals, h1v, h2v, ntv, nv, nnv, q: int = CURVE_ORDER,
-        hash_alg: str | None = None,
-    ):
-        if q.bit_length() > 256:
-            raise ValueError(
-                "SHA-256 transcripts support group orders up to 256 bits"
-            )
+    def sample_stage1(ntv, nv, q: int = CURVE_ORDER):
+        """Input-independent stage-1 nonce sampling — THE one sampler
+        for the inline prover and the offline precompute producer
+        (fsdkr_tpu.precompute; see PDLwSlackProof.sample_stage1).
+        Returns (alpha, beta, gamma, rho) columns (this prover's
+        historical sampling order: beta before gamma/rho)."""
         q3 = q**3
         alpha = [secrets.randbelow(q3) for _ in ntv]
         beta = [intops.sample_unit(n) for n in nv]
         gamma = [secrets.randbelow(q3 * nt) for nt in ntv]
         rho = [secrets.randbelow(q * nt) for nt in ntv]
+        return alpha, beta, gamma, rho
+
+    @staticmethod
+    def produce_stage1(h1, h2, nt, n, count, powm=None, q: int = CURVE_ORDER):
+        """Offline producer constructor: `count` stage-1 bundles for ONE
+        receiver environment — (alpha, beta, rho, gamma, beta^n mod n^2,
+        h2^rho mod N~, h1^alpha*h2^gamma mod N~), the same 7-tuple shape
+        as PDLwSlackProof.produce_stage1 (the two differ only in their
+        beta distribution, kept by the shared samplers)."""
+        if powm is None:
+            # plain batch engine (GMP host route); see
+            # PDLwSlackProof.produce_stage1 for the measured rationale
+            from ..backend.powm import host_powm as powm
+        from ..backend.powm import powm_columns
+
+        nn = n * n
+        alpha, beta, gamma, rho = AliceProof.sample_stage1(
+            [nt] * count, [n] * count, q
+        )
+        h2rho, ca, cg, bn = powm_columns(
+            powm,
+            ([h2] * count, rho, [nt] * count),
+            ([h1] * count, alpha, [nt] * count),
+            ([h2] * count, gamma, [nt] * count),
+            (beta, [n] * count, [nn] * count),
+        )
+        w = intops.mod_mul_col(ca, cg, [nt] * count)
+        return [
+            (alpha[i], beta[i], rho[i], gamma[i], bn[i], h2rho[i], w[i])
+            for i in range(count)
+        ]
+
+    @staticmethod
+    def generate_stage1(
+        avals, rvals, h1v, h2v, ntv, nv, nnv, q: int = CURVE_ORDER,
+        hash_alg: str | None = None, pooled=None,
+    ):
+        if q.bit_length() > 256:
+            raise ValueError(
+                "SHA-256 transcripts support group orders up to 256 bits"
+            )
         from ..backend.powm import multiexp_enabled
 
         joint = multiexp_enabled()
+        # CONTRACT: the beta^n mod n^2 column is LAST in every layout —
+        # distribute_batch splits it into the fused Paillier launch (its
+        # own sub-phase trace) by position.
+        if pooled is None:
+            alpha, beta, gamma, rho = AliceProof.sample_stage1(ntv, nv, q)
+            state = dict(
+                avals=avals, rvals=rvals, alpha=alpha, beta=beta,
+                gamma=gamma, rho=rho, ntv=ntv, nv=nv, nnv=nnv,
+                hash_alg=hash_alg, joint=joint,
+            )
+            if joint:
+                # z/w as joint multi-exponentiation rows (see
+                # PDLwSlackProof.prove_stage1): the mod_mul_col
+                # recombination moves into the planner's launch plan
+                cols = [
+                    (list(zip(h1v, h2v)), list(zip(avals, rho)), ntv),
+                    (list(zip(h1v, h2v)), list(zip(alpha, gamma)), ntv),
+                    (beta, nv, nnv),
+                ]
+            else:
+                cols = [
+                    (h1v, avals, ntv),
+                    (h2v, rho, ntv),
+                    (h1v, alpha, ntv),
+                    (h2v, gamma, ntv),
+                    (beta, nv, nnv),
+                ]
+            return state, cols
+
+        # FSDKR_PRECOMPUTE: pooled rows keep only the witness factor
+        # h1^a online (the full-rows column below deduplicates with the
+        # PDL prover's identical share column inside powm_columns); dry
+        # rows ride fallback columns, bit-identical to inline
+        rows = len(ntv)
+        fb = [i for i in range(rows) if pooled[i] is None]
+        s_alpha, s_beta, s_gamma, s_rho = AliceProof.sample_stage1(
+            [ntv[i] for i in fb], [nv[i] for i in fb], q
+        )
+        alpha = [0] * rows
+        beta = [0] * rows
+        gamma = [0] * rows
+        rho = [0] * rows
+        pool_bn, pool_h2rho, pool_w = {}, {}, {}
+        for i, p in enumerate(pooled):
+            if p is not None:
+                (alpha[i], beta[i], rho[i], gamma[i],
+                 pool_bn[i], pool_h2rho[i], pool_w[i]) = p
+        for j, i in enumerate(fb):
+            alpha[i], beta[i], gamma[i], rho[i] = (
+                s_alpha[j], s_beta[j], s_gamma[j], s_rho[j]
+            )
         state = dict(
             avals=avals, rvals=rvals, alpha=alpha, beta=beta, gamma=gamma,
             rho=rho, ntv=ntv, nv=nv, nnv=nnv, hash_alg=hash_alg, joint=joint,
+            pooled_mode=True, fb=fb, pool_bn=pool_bn, pool_h2rho=pool_h2rho,
+            pool_w=pool_w,
         )
-        # CONTRACT: the beta^n mod n^2 column is LAST in either layout —
-        # distribute_batch splits it into the fused Paillier launch (its
-        # own sub-phase trace) by position.
+        nt_fb = [ntv[i] for i in fb]
         if joint:
-            # z/w as joint multi-exponentiation rows (see
-            # PDLwSlackProof.prove_stage1): the mod_mul_col recombination
-            # moves into the planner's launch plan
-            cols = [
-                (list(zip(h1v, h2v)), list(zip(avals, rho)), ntv),
-                (list(zip(h1v, h2v)), list(zip(alpha, gamma)), ntv),
-                (beta, nv, nnv),
-            ]
+            w_cols = [(
+                [(h1v[i], h2v[i]) for i in fb],
+                [(alpha[i], gamma[i]) for i in fb],
+                nt_fb,
+            )]
         else:
-            cols = [
-                (h1v, avals, ntv),
-                (h2v, rho, ntv),
-                (h1v, alpha, ntv),
-                (h2v, gamma, ntv),
-                (beta, nv, nnv),
+            w_cols = [
+                ([h1v[i] for i in fb], [alpha[i] for i in fb], nt_fb),
+                ([h2v[i] for i in fb], [gamma[i] for i in fb], nt_fb),
             ]
+        cols = [
+            (h1v, avals, ntv),
+            ([h2v[i] for i in fb], [rho[i] for i in fb], nt_fb),
+            *w_cols,
+            ([beta[i] for i in fb], [nv[i] for i in fb],
+             [nnv[i] for i in fb]),
+        ]
         return state, cols
 
     @staticmethod
@@ -126,7 +217,26 @@ class AliceProof:
         alpha = state["alpha"]
         from ..core import paillier
 
-        if state.get("joint"):
+        if state.get("pooled_mode"):
+            fb = state["fb"]
+            rows = len(ntv)
+            h2rho = [state["pool_h2rho"].get(i) for i in range(rows)]
+            w = [state["pool_w"].get(i) for i in range(rows)]
+            bn = [state["pool_bn"].get(i) for i in range(rows)]
+            for j, i in enumerate(fb):
+                h2rho[i] = results[1][j]
+                bn[i] = results[-1][j]
+            if state.get("joint"):
+                for j, i in enumerate(fb):
+                    w[i] = results[2][j]
+            else:
+                w_fb = intops.mod_mul_col(
+                    results[2], results[3], [ntv[i] for i in fb]
+                )
+                for j, i in enumerate(fb):
+                    w[i] = w_fb[j]
+            z = intops.mod_mul_col(results[0], h2rho, ntv)
+        elif state.get("joint"):
             z, w, bn = results
         else:
             c1, c2, c3, c4, bn = results

@@ -62,6 +62,21 @@ class NiCorrectKeyProof:
     sigma_vec: List[int]
 
     @staticmethod
+    def derive_targets(
+        n: int,
+        salt: bytes = SALT_STRING,
+        rounds: int = DEFAULT_CONFIG.correct_key_rounds,
+        hash_alg: str | None = None,
+    ) -> List[int]:
+        """The Fiat-Shamir-derived group elements rho_i the prover must
+        root — a pure function of the PUBLIC modulus (no prover nonces
+        at all), shared by proof_batch and the batched verifier. Because
+        the whole proof depends on the key alone, complete proofs are
+        input-independent and ride the precompute key-material pool
+        (fsdkr_tpu/precompute)."""
+        return [_derive_rho(n, salt, i, hash_alg) for i in range(rounds)]
+
+    @staticmethod
     def proof(
         dk: DecryptionKey,
         salt: bytes = SALT_STRING,
@@ -93,7 +108,7 @@ class NiCorrectKeyProof:
             n = dk.p * dk.q
             phi = (dk.p - 1) * (dk.q - 1)
             d = pow(n, -1, phi)  # x -> x^d inverts x -> x^N on Z_N^*
-            bases += [_derive_rho(n, salt, i, hash_alg) for i in range(rounds)]
+            bases += NiCorrectKeyProof.derive_targets(n, salt, rounds, hash_alg)
             exps += [d] * rounds
             mods += [n] * rounds
             factors += [(dk.p, dk.q)] * rounds

@@ -109,7 +109,60 @@ class PDLwSlackProof:
     # depth, not row count, prices a launch (backend.powm.powm_columns).
 
     @staticmethod
-    def prove_stage1(witnesses, h1v, h2v, ntv, nv, nnv, hash_alg=None):
+    def sample_stage1(ntv, nv):
+        """Input-independent stage-1 nonce sampling for len(ntv) rows —
+        THE one sampler for both the inline prover and the offline
+        precompute producer (fsdkr_tpu.precompute), so pooled and inline
+        runs draw from identical distributions in identical per-row
+        order (the seeded-parity contract of tests/test_precompute.py).
+        Returns (alpha, beta, rho, gamma) columns."""
+        q = CURVE_ORDER
+        q3 = q**3
+        alpha = [secrets.randbelow(q3) for _ in ntv]
+        beta = [1 + secrets.randbelow(n - 1) for n in nv]
+        rho = [secrets.randbelow(q * nt) for nt in ntv]
+        gamma = [secrets.randbelow(q3 * nt) for nt in ntv]
+        return alpha, beta, rho, gamma
+
+    @staticmethod
+    def produce_stage1(h1, h2, nt, n, count, powm=None):
+        """Offline producer constructor (fsdkr_tpu.precompute): sample
+        `count` rows of stage-1 nonces for ONE receiver environment and
+        evaluate every input-independent power. Returns pool bundles
+        (alpha, beta, rho, gamma, beta^n mod n^2, h2^rho mod N~,
+        h1^alpha*h2^gamma mod N~) — exactly the values prove_stage1
+        samples and computes inline (same sampler, same arithmetic), so
+        consumption is bit-identical. The witness-dependent factor h1^x
+        and everything downstream of the Fiat-Shamir challenge stay
+        online by construction."""
+        if powm is None:
+            # plain batch engine (GMP host route): measured 1.8x faster
+            # than the grouped own-core comb for the producer shape on
+            # this box (a 16-row group cannot amortize a fresh comb
+            # build, and the beta^n rows are secret-base loners anyway)
+            from ..backend.powm import host_powm as powm
+        from ..backend.powm import powm_columns
+
+        nn = n * n
+        alpha, beta, rho, gamma = PDLwSlackProof.sample_stage1(
+            [nt] * count, [n] * count
+        )
+        h2rho, ca, cg, bn = powm_columns(
+            powm,
+            ([h2] * count, rho, [nt] * count),
+            ([h1] * count, alpha, [nt] * count),
+            ([h2] * count, gamma, [nt] * count),
+            (beta, [n] * count, [nn] * count),
+        )
+        u3 = intops.mod_mul_col(ca, cg, [nt] * count)
+        return [
+            (alpha[i], beta[i], rho[i], gamma[i], bn[i], h2rho[i], u3[i])
+            for i in range(count)
+        ]
+
+    @staticmethod
+    def prove_stage1(witnesses, h1v, h2v, ntv, nv, nnv, hash_alg=None,
+                     pooled=None):
         """Sample nonces, return (state, columns). Under FSDKR_MULTIEXP
         the two mod-N~ commitment pairs are submitted as joint
         multi-exponentiation rows (z = h1^x h2^rho, u3 = h1^alpha
@@ -118,38 +171,87 @@ class PDLwSlackProof:
         mod_mul_col columns disappear; =0 keeps the per-term column
         layout. CONTRACT: the beta^n mod n^2 column is LAST in either
         layout — distribute_batch splits it into the fused Paillier
-        launch (its own sub-phase trace) by position."""
-        q = CURVE_ORDER
-        q3 = q**3
-        alpha = [secrets.randbelow(q3) for _ in ntv]
-        beta = [1 + secrets.randbelow(n - 1) for n in nv]
-        rho = [secrets.randbelow(q * nt) for nt in ntv]
-        gamma = [secrets.randbelow(q3 * nt) for nt in ntv]
+        launch (its own sub-phase trace) by position.
+
+        `pooled` (FSDKR_PRECOMPUTE): a per-row list of Optional
+        produce_stage1 bundles. Pooled rows contribute NO offline-
+        computable columns — only the witness factor h1^x remains (one
+        column over all rows, which deduplicates with the Alice prover's
+        identical share column in powm_columns); rows with a dry pool
+        (None) ride fallback columns, bit-identical to inline."""
         from ..backend.powm import multiexp_enabled
 
         joint = multiexp_enabled()
+        if pooled is None:
+            alpha, beta, rho, gamma = PDLwSlackProof.sample_stage1(ntv, nv)
+            state = dict(
+                witnesses=witnesses, alpha=alpha, beta=beta, rho=rho,
+                gamma=gamma, ntv=ntv, nv=nv, nnv=nnv, hash_alg=hash_alg,
+                joint=joint,
+            )
+            if joint:
+                cols = [
+                    (
+                        list(zip(h1v, h2v)),
+                        [(w.x.to_int(), r) for w, r in zip(witnesses, rho)],
+                        ntv,
+                    ),
+                    (list(zip(h1v, h2v)), list(zip(alpha, gamma)), ntv),
+                    (beta, nv, nnv),
+                ]
+            else:
+                cols = [
+                    (h1v, [w.x.to_int() for w in witnesses], ntv),
+                    (h2v, rho, ntv),
+                    (h1v, alpha, ntv),
+                    (h2v, gamma, ntv),
+                    (beta, nv, nnv),
+                ]
+            return state, cols
+
+        rows = len(ntv)
+        fb = [i for i in range(rows) if pooled[i] is None]
+        s_alpha, s_beta, s_rho, s_gamma = PDLwSlackProof.sample_stage1(
+            [ntv[i] for i in fb], [nv[i] for i in fb]
+        )
+        alpha = [0] * rows
+        beta = [0] * rows
+        rho = [0] * rows
+        gamma = [0] * rows
+        pool_bn, pool_h2rho, pool_u3 = {}, {}, {}
+        for i, p in enumerate(pooled):
+            if p is not None:
+                (alpha[i], beta[i], rho[i], gamma[i],
+                 pool_bn[i], pool_h2rho[i], pool_u3[i]) = p
+        for j, i in enumerate(fb):
+            alpha[i], beta[i], rho[i], gamma[i] = (
+                s_alpha[j], s_beta[j], s_rho[j], s_gamma[j]
+            )
         state = dict(
             witnesses=witnesses, alpha=alpha, beta=beta, rho=rho, gamma=gamma,
             ntv=ntv, nv=nv, nnv=nnv, hash_alg=hash_alg, joint=joint,
+            pooled_mode=True, fb=fb, pool_bn=pool_bn, pool_h2rho=pool_h2rho,
+            pool_u3=pool_u3,
         )
+        nt_fb = [ntv[i] for i in fb]
         if joint:
-            cols = [
-                (
-                    list(zip(h1v, h2v)),
-                    [(w.x.to_int(), r) for w, r in zip(witnesses, rho)],
-                    ntv,
-                ),
-                (list(zip(h1v, h2v)), list(zip(alpha, gamma)), ntv),
-                (beta, nv, nnv),
-            ]
+            u3_cols = [(
+                [(h1v[i], h2v[i]) for i in fb],
+                [(alpha[i], gamma[i]) for i in fb],
+                nt_fb,
+            )]
         else:
-            cols = [
-                (h1v, [w.x.to_int() for w in witnesses], ntv),
-                (h2v, rho, ntv),
-                (h1v, alpha, ntv),
-                (h2v, gamma, ntv),
-                (beta, nv, nnv),
+            u3_cols = [
+                ([h1v[i] for i in fb], [alpha[i] for i in fb], nt_fb),
+                ([h2v[i] for i in fb], [gamma[i] for i in fb], nt_fb),
             ]
+        cols = [
+            (h1v, [w.x.to_int() for w in witnesses], ntv),
+            ([h2v[i] for i in fb], [rho[i] for i in fb], nt_fb),
+            *u3_cols,
+            ([beta[i] for i in fb], [nv[i] for i in fb],
+             [nnv[i] for i in fb]),
+        ]
         return state, cols
 
     @staticmethod
@@ -160,7 +262,26 @@ class PDLwSlackProof:
         alpha = state["alpha"]
         from ..core import paillier
 
-        if state.get("joint"):
+        if state.get("pooled_mode"):
+            fb = state["fb"]
+            rows = len(ntv)
+            h2rho = [state["pool_h2rho"].get(i) for i in range(rows)]
+            u3 = [state["pool_u3"].get(i) for i in range(rows)]
+            bn = [state["pool_bn"].get(i) for i in range(rows)]
+            for j, i in enumerate(fb):
+                h2rho[i] = results[1][j]
+                bn[i] = results[-1][j]
+            if state.get("joint"):
+                for j, i in enumerate(fb):
+                    u3[i] = results[2][j]
+            else:
+                u3_fb = intops.mod_mul_col(
+                    results[2], results[3], [ntv[i] for i in fb]
+                )
+                for j, i in enumerate(fb):
+                    u3[i] = u3_fb[j]
+            z = intops.mod_mul_col(results[0], h2rho, ntv)
+        elif state.get("joint"):
             z, u3, bn = results
         else:
             c1, c2, c3, c4, bn = results

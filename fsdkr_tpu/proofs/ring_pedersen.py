@@ -115,6 +115,21 @@ class RingPedersenProof:
         )[0]
 
     @staticmethod
+    def sample_commit(
+        witnesses: List[RingPedersenWitness],
+        m_security: int = DEFAULT_CONFIG.m_security,
+    ) -> List[List[int]]:
+        """M-round commitment nonces a_i < phi per witness — THE one
+        sampler for the inline prover and the offline key-material
+        producer (fsdkr_tpu.precompute), split from the challenge-
+        response so pooled and inline runs draw identically (the
+        seeded-parity contract of tests/test_precompute.py)."""
+        return [
+            [secrets.randbelow(w.phi) for _ in range(m_security)]
+            for w in witnesses
+        ]
+
+    @staticmethod
     def prove_batch(
         witnesses: List[RingPedersenWitness],
         statements: List[RingPedersenStatement],
@@ -124,7 +139,12 @@ class RingPedersenProof:
     ) -> List["RingPedersenProof"]:
         """All provers' M-round commitment columns in ONE modexp launch;
         each prover's rows share (T, N), so the fixed-base comb kernel
-        picks them up as a group."""
+        picks them up as a group.
+
+        The proof depends on (witness, statement) ALONE — the challenge
+        binds only the prover's own commitments — so whole proofs are
+        input-independent and ride the precompute key-material pool
+        (fsdkr_tpu/precompute) together with their statements."""
         if powm is None:
             from ..backend.powm import host_powm as powm
         if len(witnesses) != len(statements):
@@ -132,10 +152,7 @@ class RingPedersenProof:
                 f"batch length mismatch: {len(witnesses)} witnesses, "
                 f"{len(statements)} statements"
             )
-        a_all = [
-            [secrets.randbelow(w.phi) for _ in range(m_security)]
-            for w in witnesses
-        ]
+        a_all = RingPedersenProof.sample_commit(witnesses, m_security)
         from ..backend import crt
 
         if crt.crt_enabled():

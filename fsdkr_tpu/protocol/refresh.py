@@ -105,8 +105,14 @@ class RefreshMessage:
         Mutates each local_key.vss_scheme.
         """
         from ..backend.powm import get_batch_powm
+        from .. import precompute
 
         powm = get_batch_powm(config)
+        # FSDKR_PRECOMPUTE (fsdkr_tpu/precompute): consume-or-compute at
+        # every phase boundary below — pooled rows take their offline-
+        # produced values (bit-identical to inline sampling+compute),
+        # dry rows fall back to the inline columns of that same phase
+        pre_on = precompute.enabled()
 
         # validate every sender BEFORE the first mutation: a late failure
         # must not leave earlier senders' vss_scheme replaced by schemes
@@ -124,9 +130,16 @@ class RefreshMessage:
                 local_key.t, new_n, local_key.keys_linear.x_i
             )
             receiver_eks = [local_key.paillier_key_vec[i] for i in range(new_n)]
-            randomness_vec = [
-                paillier.sample_randomness(ek_i) for ek_i in receiver_eks
-            ]
+            randomness_vec = []
+            rn_vec = []  # pooled r^n mod n^2 per receiver (None -> inline)
+            for ek_i in receiver_eks:
+                ent = precompute.take("enc", ek_i.n) if pre_on else None
+                if ent is None:
+                    randomness_vec.append(paillier.sample_randomness(ek_i))
+                    rn_vec.append(None)
+                else:
+                    randomness_vec.append(ent[0])
+                    rn_vec.append(ent[1])
             per.append(
                 dict(
                     old_i=old_party_index,
@@ -135,6 +148,7 @@ class RefreshMessage:
                     shares=secret_shares,
                     eks=receiver_eks,
                     rand=randomness_vec,
+                    rn=rn_vec,
                 )
             )
 
@@ -198,6 +212,7 @@ class RefreshMessage:
         from ..backend.powm import powm_columns
 
         flat_rand = [r for p in per for r in p["rand"]]
+        flat_rn = [x for p in per for x in p["rn"]]
         flat_nv = [ek.n for p in per for ek in p["eks"]]
         flat_nnv = [ek.nn for p in per for ek in p["eks"]]
         flat_h1 = [p["key"].h1_h2_n_tilde_vec[i].g for p in per for i in range(new_n)]
@@ -218,45 +233,66 @@ class RefreshMessage:
             # prove_stage1/generate_stage1), so the full-width public-
             # exponent columns (enc r^n + both beta^n — one width class)
             # stay fused in one launch set, and the h1/h2 joint columns
-            # keep their cross-family comb groups in the other.
+            # keep their cross-family comb groups in the other. Under
+            # FSDKR_PRECOMPUTE the pooled rows vanish from both launch
+            # sets (their powers were produced offline); only the
+            # witness factor h1^x — one column, shared by both families
+            # via powm_columns dedup — plus any dry-pool fallback rows
+            # remain on the online critical path.
+            pooled_pdl = pooled_alice = None
             with phase("distribute.stage1.sample", items=len(flat_rand)):
+                if pre_on:
+                    envs = list(zip(flat_h1, flat_h2, flat_nt, flat_nv))
+                    pooled_pdl = [precompute.take("pdl", e) for e in envs]
+                    pooled_alice = [precompute.take("alice", e) for e in envs]
                 pdl_state, pdl_cols = PDLwSlackProof.prove_stage1(
                     flat_witnesses, flat_h1, flat_h2, flat_nt, flat_nv,
-                    flat_nnv, hash_alg=config.hash_alg,
+                    flat_nnv, hash_alg=config.hash_alg, pooled=pooled_pdl,
                 )
                 alice_state, alice_cols = AliceProof.generate_stage1(
                     flat_share_ints, flat_rand, flat_h1, flat_h2, flat_nt,
                     flat_nv, flat_nnv, hash_alg=config.hash_alg,
+                    pooled=pooled_alice,
                 )
-            enc_col = (flat_rand, flat_nv, flat_nnv)  # r^n mod n^2
+            # encryption column r^n mod n^2: only rows without a pooled
+            # randomizer power
+            enc_fb = [i for i, x in enumerate(flat_rn) if x is None]
+            enc_col = (
+                [flat_rand[i] for i in enc_fb],
+                [flat_nv[i] for i in enc_fb],
+                [flat_nnv[i] for i in enc_fb],
+            )
             with phase(
-                "distribute.stage1.enc_beta_pow", items=3 * len(flat_rand)
+                "distribute.stage1.enc_beta_pow",
+                items=len(enc_col[0])
+                + len(pdl_cols[-1][0]) + len(alice_cols[-1][0]),
             ):
                 res_pail = powm_columns(
                     powm, enc_col, pdl_cols[-1], alice_cols[-1]
                 )
             with phase(
                 "distribute.stage1.commit_pow",
-                items=(len(pdl_cols) + len(alice_cols) - 2) * len(flat_rand),
+                items=sum(
+                    len(c[0]) for c in pdl_cols[:-1] + alice_cols[:-1]
+                ),
             ):
                 res_commit = powm_columns(
                     powm, *pdl_cols[:-1], *alice_cols[:-1]
                 )
             n_pdl = len(pdl_cols)
-            res1 = (
-                [res_pail[0]]
-                + res_commit[: n_pdl - 1] + [res_pail[1]]
-                + res_commit[n_pdl - 1 :] + [res_pail[2]]
-            )
-            pdl_res1 = res1[1 : 1 + n_pdl]
-            alice_res1 = res1[1 + n_pdl : 1 + n_pdl + len(alice_cols)]
+            pdl_res1 = res_commit[: n_pdl - 1] + [res_pail[1]]
+            alice_res1 = res_commit[n_pdl - 1 :] + [res_pail[2]]
+            rn_full = list(flat_rn)
+            for j, i in enumerate(enc_fb):
+                rn_full[i] = res_pail[0][j]
 
         # ciphertexts from the fused encryption column (randomness is
-        # unit-sampled above, the guarantee encrypt_with_randomness_batch
-        # enforces); own phase: ~n^2 host bigint multiplies at scale
+        # unit-sampled above — inline or by the pool producer, the
+        # guarantee encrypt_with_randomness_batch enforces); own phase:
+        # ~n^2 host bigint multiplies at scale
         with phase("distribute.encrypt", items=len(flat_share_ints)):
             flat_enc = paillier.combine_with_rn(
-                flat_share_ints, res1[0], flat_nv, flat_nnv
+                flat_share_ints, rn_full, flat_nv, flat_nnv
             )
         # (the share ints also live on as alice_state["avals"] until the
         # proofs are assembled — same round-state lifetime as the nonces)
@@ -294,25 +330,61 @@ class RefreshMessage:
                 alice_state, res2[len(pdl_cols2) :]
             )
 
-        # ---- per-sender keygens (batched prime pipeline: candidate
-        # windows through the FSDKR_THREADS-parallel Miller-Rabin batch
-        # instead of 2 serial gen_prime loops per sender) and fused
-        # correct-key / ring-Pedersen prover columns (secret-CRT engine
-        # under FSDKR_CRT)
-        with phase("distribute.keygen", items=len(per)):
-            ek_dk = paillier.keygen_batch(config.paillier_bits, len(per))
-        with phase("distribute.ring_pedersen_gen", items=len(per)):
-            rp = RingPedersenStatement.generate_batch(len(per), config)
-        with phase("distribute.correct_key_prove", items=len(per)):
-            ck_proofs = NiCorrectKeyProof.proof_batch(
-                [dk for _, dk in ek_dk], rounds=config.correct_key_rounds,
-                powm=powm, hash_alg=config.hash_alg,
+        # ---- per-sender key material: consume pooled bundles first
+        # (complete offline-produced ek/dk + correct-key proof + ring-
+        # Pedersen statement+proof — every part a function of the fresh
+        # key alone), then batch the remainder inline — batched prime
+        # pipeline (candidate windows through the FSDKR_THREADS-parallel
+        # Miller-Rabin batch) and fused correct-key / ring-Pedersen
+        # prover columns (secret-CRT engine under FSDKR_CRT)
+        key_bundles: list = []
+        if pre_on:
+            kp = config.key_material_pool_key
+            for _ in per:
+                b = precompute.take("keys", kp)
+                if b is None:
+                    break  # dry: the remaining senders compute inline
+                key_bundles.append(b)
+        # phase item counts follow the stage-1 convention: only the
+        # inline-computed rows are this phase's work (pooled bundles
+        # cost a pop, not a keygen)
+        miss = len(per) - len(key_bundles)
+        with phase("distribute.keygen", items=miss):
+            ek_dk_inline = (
+                paillier.keygen_batch(config.paillier_bits, miss)
+                if miss else []
             )
-        with phase("distribute.ring_pedersen_prove", items=len(per)):
-            rp_proofs = RingPedersenProof.prove_batch(
-                [w for _, w in rp], [st for st, _ in rp], config.m_security,
-                powm, config.hash_alg,
+        with phase("distribute.ring_pedersen_gen", items=miss):
+            rp_inline = (
+                RingPedersenStatement.generate_batch(miss, config)
+                if miss else []
             )
+        with phase("distribute.correct_key_prove", items=miss):
+            ck_inline = (
+                NiCorrectKeyProof.proof_batch(
+                    [dk for _, dk in ek_dk_inline],
+                    rounds=config.correct_key_rounds,
+                    powm=powm, hash_alg=config.hash_alg,
+                )
+                if miss else []
+            )
+        with phase("distribute.ring_pedersen_prove", items=miss):
+            rp_proofs_inline = (
+                RingPedersenProof.prove_batch(
+                    [w for _, w in rp_inline], [st for st, _ in rp_inline],
+                    config.m_security, powm, config.hash_alg,
+                )
+                if miss else []
+            )
+        # merged per-sender views: pooled bundles fill the first slots
+        # (take order), inline results the rest — deterministic, so the
+        # seeded-parity arms assign identical material to each sender
+        ek_dk = [(b[0], b[1]) for b in key_bundles] + ek_dk_inline
+        ck_proofs = [b[2] for b in key_bundles] + ck_inline
+        rp_statements = (
+            [b[3] for b in key_bundles] + [st for st, _ in rp_inline]
+        )
+        rp_proofs = [b[4] for b in key_bundles] + rp_proofs_inline
 
         out = []
         for k, p in enumerate(per):
@@ -330,10 +402,36 @@ class RefreshMessage:
                 ek=ek_dk[k][0],
                 remove_party_indices=[],
                 public_key=local_key.y_sum_s,
-                ring_pedersen_statement=rp[k][0],
+                ring_pedersen_statement=rp_statements[k],
                 ring_pedersen_proof=rp_proofs[k],
             )
             out.append((msg, ek_dk[k][1]))
+
+        # ---- steady-state refill targets: next epoch's demand is what
+        # this call consumed, keyed by the NEXT epoch's receiver moduli —
+        # collect() installs each sender's fresh ek into
+        # paillier_key_vec, so the Paillier-width pools must be produced
+        # against the keys just generated (the mod-N~ environments are
+        # stable across refreshes). The background producer then fills
+        # during idle time / overlapped with collect().
+        if pre_on:
+            next_eks = list(senders[0][1].paillier_key_vec[:new_n])
+            for k, p in enumerate(per):
+                idx = p["key"].i
+                if 1 <= idx <= new_n:
+                    next_eks[idx - 1] = ek_dk[k][0]
+            targets = []
+            for i in range(new_n):
+                d = senders[0][1].h1_h2_n_tilde_vec[i]
+                env = (d.g, d.ni, d.N, next_eks[i].n)
+                targets += [
+                    ("enc", next_eks[i].n, len(per)),
+                    ("pdl", env, len(per)),
+                    ("alice", env, len(per)),
+                ]
+            targets.append(("keys", config.key_material_pool_key, len(per)))
+            precompute.register_targets(targets)
+            precompute.kick()
         return out
 
     # ------------------------------------------------------------------
@@ -503,6 +601,12 @@ class RefreshMessage:
         never blocks the others).
         """
         backend = get_backend(config)
+        # idle-time pool refill (FSDKR_PRECOMPUTE): verification's
+        # native/GMP launches release the GIL, so the background
+        # producer's offline work genuinely overlaps this collect
+        from .. import precompute
+
+        precompute.kick()
         S = len(sessions)
         errors: List[Optional[Exception]] = [None] * S
         new_ns: List[int] = [0] * S

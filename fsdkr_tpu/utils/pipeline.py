@@ -19,9 +19,15 @@ sequential loop (A/B isolation and debugging).
 from __future__ import annotations
 
 import os
+import threading
 from typing import Callable, List, Optional, Sequence
 
-__all__ = ["pipeline_enabled", "pipelined", "submit_bg"]
+__all__ = [
+    "pipeline_enabled",
+    "pipelined",
+    "submit_bg",
+    "BackgroundProducer",
+]
 
 _DEPTH = 2  # double-buffered: one tile staging while one executes
 
@@ -90,3 +96,74 @@ def submit_bg(fn: Callable) -> Optional["object"]:
     fut = ex.submit(worker)
     ex.shutdown(wait=False)  # the future still completes; no leak
     return fut
+
+
+class BackgroundProducer:
+    """One daemon scheduling thread pulling work off a `step` callable —
+    the producer half of the precompute offline/online split
+    (fsdkr_tpu.precompute.producer builds `step` from the pool targets).
+
+    `step()` performs one bounded unit of production and returns True if
+    it did work; the thread loops while steps report work, then parks on
+    an event until `kick()`. The scheduling thread itself is single (the
+    production batches already fan out across the FSDKR_THREADS row
+    pools of the native/GMP engines, and those calls release the GIL —
+    which is exactly how production overlaps a concurrent collect() on
+    the main thread); adding scheduler threads would only oversubscribe
+    the same engine pool. Exceptions in `step` park the producer instead
+    of killing the interpreter: production is an optimization, never a
+    correctness dependency (consumers fall back inline on a dry pool).
+    """
+
+    def __init__(self, step: Callable[[], bool], name: str = "fsdkr-precompute"):
+        self._step = step
+        self._name = name
+        self._wake = threading.Event()
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._thread_stop: Optional[threading.Event] = None
+        self.errors = 0
+
+    def _loop(self, stop: threading.Event) -> None:
+        while not stop.is_set():
+            try:
+                worked = self._step()
+            except Exception:
+                self.errors += 1
+                worked = False
+            if not worked:
+                self._wake.wait(timeout=60.0)
+                self._wake.clear()
+
+    def kick(self) -> None:
+        """Start the thread if needed and wake it (idempotent, cheap)."""
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                # each thread gets its OWN stop event: a stop() racing
+                # this kick() signals the old thread's event, and the
+                # fresh thread cannot observe that (or any later) set —
+                # two producer loops can never run side by side
+                self._thread_stop = threading.Event()
+                self._thread = threading.Thread(
+                    target=self._loop, args=(self._thread_stop,),
+                    name=self._name, daemon=True,
+                )
+                self._thread.start()
+        self._wake.set()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        with self._lock:
+            t = self._thread
+            stop = self._thread_stop
+            self._thread = None
+            self._thread_stop = None
+            if stop is not None:
+                stop.set()
+        if t is None:
+            return
+        self._wake.set()
+        t.join(timeout=timeout)
+
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()

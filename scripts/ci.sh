@@ -57,4 +57,13 @@ FSDKR_CRT=0 FSDKR_GMP=0 python -m pytest tests/test_crt.py \
   tests/test_proofs.py tests/test_native.py tests/test_thread_parity.py \
   -q -m "not slow and not heavy" -p no:cacheprovider
 
+echo "== test: FSDKR_PRECOMPUTE=0 leg (inline prover path) =="
+# the smoke tier above ran with the default FSDKR_PRECOMPUTE=1 (pool
+# consume-or-compute in distribute); this leg forces the inline path on
+# the prover-facing suites so the no-pool code cannot rot unexercised
+# (same pattern as the FSDKR_RLC=0 / FSDKR_CRT=0 legs)
+FSDKR_PRECOMPUTE=0 python -m pytest tests/test_precompute.py \
+  tests/test_protocol.py tests/test_proofs.py -q \
+  -m "not slow and not heavy" -p no:cacheprovider
+
 echo "== ci.sh: all gates green =="

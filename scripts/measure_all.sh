@@ -166,6 +166,15 @@ run n16_norlc 2400 FSDKR_RLC=0 FSDKR_TRACE=1 python bench.py
 # the n16_norlc pattern). The CPU-platform acceptance pair is
 # bench_results/crt_ab_n16_{on,off}.json.
 run n16_nocrt 2400 FSDKR_CRT=0 FSDKR_TRACE=1 python bench.py
+# precompute offline/online split A/B (FSDKR_PRECOMPUTE: =0 reverts
+# distribute() to the inline path — no pools, no prefill; =1 is the
+# default — the nominal n16 step above measures it and emits
+# distribute_online_s / precompute_offline_s plus the "precompute"
+# stats block {produced, consumed, dry_fallbacks, wiped, bytes_pooled};
+# this step is the off arm at the same n=16 full-2048-bit shape,
+# mirroring the n16_nocrt pattern). The CPU-platform acceptance pair is
+# bench_results/precompute_ab_n16_{on,off}.json.
+run n16_noprecompute 2400 FSDKR_PRECOMPUTE=0 FSDKR_TRACE=1 python bench.py
 
 # host-engine thread scaling (FSDKR_THREADS row pool; 1 = the historical
 # serial loop, auto = all cores). Pinned to the CPU platform + host

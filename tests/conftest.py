@@ -33,6 +33,16 @@ except AttributeError:
 os.environ.setdefault("FSDKR_DEVICE_EC", "1")
 os.environ.setdefault("FSDKR_DEVICE_POWM", "1")
 
+# The background precompute producer (fsdkr_tpu.precompute.producer) is
+# an optimization thread, not a correctness dependency: pools fall back
+# inline when dry. Keep it off in the suite so tests are deterministic
+# (seeded-nonce tests monkeypatch the samplers process-globally) and the
+# single-core box doesn't time-share production against the tests; the
+# dedicated concurrency test in test_precompute.py turns it on
+# explicitly. FSDKR_PRECOMPUTE itself stays at its default (on), so the
+# consume-or-compute path is exercised by every protocol test.
+os.environ.setdefault("FSDKR_PRECOMPUTE_BG", "0")
+
 import pytest  # noqa: E402
 
 from fsdkr_tpu.config import TEST_CONFIG  # noqa: E402

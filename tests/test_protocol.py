@@ -169,6 +169,34 @@ class TestWireTamper:
             RefreshMessage.collect(msgs, keys[0], dks[0], (), CFG)
         assert ei.value.party_index == msgs[1].party_index  # culprit named
 
+    def test_lying_old_party_index_rejected(self):
+        """Regression pin for reference quirk 4: the TODO at
+        src/refresh_message.rs:199 leaves the broadcast old_party_index
+        untrusted-but-unchecked, so a sender lying about its old index
+        reweights the Lagrange combination and would silently rotate the
+        committee onto a DIFFERENT secret. This rebuild's hardening gate
+        (interpolate_constant_term in protocol/refresh.py: the weighted
+        Feldman constant terms must re-derive the unchanged group key)
+        must abort with PublicShareValidationError instead."""
+        from fsdkr_tpu.errors import PublicShareValidationError
+
+        t, n = 1, 3
+        keys = simulate_keygen(t, n, CFG)
+        msgs, dks = [], []
+        for key in keys:
+            m, dk = RefreshMessage.distribute(key.i, key, n, CFG)
+            msgs.append(m)
+            dks.append(dk)
+        # swap the first two senders' old indices: both values stay
+        # individually plausible (distinct, in range), only the
+        # attribution lies — exactly the case the reference TODO admits
+        msgs[0].old_party_index, msgs[1].old_party_index = (
+            msgs[1].old_party_index,
+            msgs[0].old_party_index,
+        )
+        with pytest.raises(PublicShareValidationError):
+            RefreshMessage.collect(msgs, keys[2].clone(), dks[2], (), CFG)
+
     def test_tampered_ciphertext_detected(self):
         """A malicious sender mutating an encrypted share must be caught by
         the proof batch (identifiable abort)."""
