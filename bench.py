@@ -248,6 +248,35 @@ def rlc_fields():
     return {"rlc_enabled": rlc.rlc_enabled(), "rlc": rlc.stats()}
 
 
+def telemetry_fields():
+    """The unified telemetry block (ISSUE 6): ONE schema-versioned
+    registry snapshot — per-phase latency histograms with interpolated
+    p50/p95/p99, pool depth/occupancy gauges, producer occupancy, and
+    the subsystem counters the legacy rlc/crt/precompute keys mirror
+    (those stay for comparability with old BENCH_r0*.json files; this
+    is the structured read going forward)."""
+    from fsdkr_tpu.telemetry import export
+
+    return {"telemetry": export.snapshot()}
+
+
+def telemetry_artifacts():
+    """Write the export artifacts when their env knobs ask for them:
+    FSDKR_TRACE_OUT (Chrome-trace/Perfetto timeline of the recorded
+    spans) and FSDKR_METRICS_DUMP (Prometheus text exposition). The
+    package atexit hook would catch these too; writing here pins the
+    artifacts even if the interpreter dies later."""
+    from fsdkr_tpu.telemetry import export
+    from fsdkr_tpu.utils.trace import get_tracer
+
+    path = os.environ.get("FSDKR_TRACE_OUT")
+    if path and get_tracer().spans():
+        log(f"chrome trace -> {get_tracer().write_chrome_trace(path)}")
+    dumped = export.maybe_dump_metrics()
+    if dumped:
+        log(f"metrics dump -> {dumped}")
+
+
 def roofline_fields(t_warm, stats=None):
     """mfu/gmacs fields for a bench JSON, from tracer stats accumulated
     during the warm run (caller resets the tracer before it), or from an
@@ -325,7 +354,7 @@ def bench_sessions(sessions_count, n, t, bits, m_sec):
     from fsdkr_tpu.backend import rlc
     from fsdkr_tpu.utils.trace import get_tracer
 
-    get_tracer().reset()
+    get_tracer().reset(keep_spans=True)
     rlc.stats_reset()
     t_warm = run()
     total_proofs = proofs_per_session * sessions_count
@@ -354,8 +383,10 @@ def bench_sessions(sessions_count, n, t, bits, m_sec):
             **rlc_fields(),
             **precompute_fields(),
             **roofline_fields(t_warm),
+            **telemetry_fields(),
         }
     )
+    telemetry_artifacts()
 
 
 def bench_join(n, t, bits, m_sec, joins):
@@ -408,7 +439,7 @@ def bench_join(n, t, bits, m_sec, joins):
     from fsdkr_tpu.backend import rlc
     from fsdkr_tpu.utils.trace import get_tracer
 
-    get_tracer().reset()
+    get_tracer().reset(keep_spans=True)
     rlc.stats_reset()
     t0 = time.time()
     RefreshMessage.collect(msgs, keys[1].clone(), dks[1], join_messages, tpu_cfg)
@@ -434,8 +465,10 @@ def bench_join(n, t, bits, m_sec, joins):
             **({"degraded": os.environ["BENCH_DEGRADED"]}
                if os.environ.get("BENCH_DEGRADED") else {}),
             **roofline_fields(t_warm),
+            **telemetry_fields(),
         }
     )
+    telemetry_artifacts()
 
 
 def main():
@@ -510,12 +543,33 @@ def main():
     from fsdkr_tpu import precompute
 
     precompute.stats_reset()
+    # with tracing on, run the background producer ALONGSIDE the
+    # synchronous prefill (both race to fill the same bounded pools):
+    # the trace timeline then shows genuine producer-THREAD spans, the
+    # occupancy gauge reads non-zero, and the measured sections below
+    # are untouched (BG is forced back off before any of them)
+    bg_for_trace = get_tracer().enabled and precompute.enabled()
+    bg_user = os.environ["FSDKR_PRECOMPUTE_BG"]  # setdefault'd in main()
+    if bg_for_trace:
+        os.environ["FSDKR_PRECOMPUTE_BG"] = "1"
+        precompute.register_committee(keys[0], n, n, tpu_cfg)
+        precompute.kick()
     t0 = time.time()
     pre_produced = precompute.prefill(keys[0], n, n, tpu_cfg)
     t_offline = time.time() - t0
+    if bg_for_trace:
+        # restore the caller's knob: an explicit FSDKR_PRECOMPUTE_BG=1
+        # keeps the producer running through the measured sections (an
+        # overlap experiment); only the bench's own default of 0 stops it
+        os.environ["FSDKR_PRECOMPUTE_BG"] = bg_user
+        from fsdkr_tpu.precompute.producer import background_enabled
+
+        if not background_enabled():
+            precompute.stop_background()
     log(
         f"precompute offline fill: {pre_produced} entries in "
-        f"{t_offline:.2f}s (enabled={precompute.enabled()})"
+        f"{t_offline:.2f}s (enabled={precompute.enabled()}, "
+        f"bg_overlap={bg_for_trace})"
     )
 
     # --- WARM-epoch distribute: proactive refresh re-runs on the same
@@ -529,7 +583,7 @@ def main():
     from fsdkr_tpu.backend import crt as crt_mod
     from fsdkr_tpu.backend.powm import powm_cache_stats
 
-    get_tracer().reset()
+    get_tracer().reset(keep_spans=True)
     crt_mod.stats_reset()
     primes_mod.gen_stats_reset()
     cache_d0 = powm_cache_stats()
@@ -617,7 +671,7 @@ def main():
     from fsdkr_tpu.backend.powm import powm_cache_stats
 
     cache_cold = powm_cache_stats()
-    get_tracer().reset()
+    get_tracer().reset(keep_spans=True)
     rlc.stats_reset()
     t0 = time.time()
     RefreshMessage.collect(msgs, keys[1].clone(), dks[1], (), tpu_cfg)
@@ -637,6 +691,56 @@ def main():
             name: round(st.seconds, 3) for name, st in stats.items()
         }
         rf = roofline_fields(t_tpu, stats)
+    # snapshot the warm-collect stat windows BEFORE the trace A/B below
+    # runs extra collects in this process — the legacy rlc block and the
+    # telemetry snapshot must describe ONE warm collect, same as every
+    # other BENCH_*.json (old-BENCH comparability)
+    rlc_out = rlc_fields()
+    telemetry_out = telemetry_fields()
+
+    # --- trace-overhead A/B (BENCH_TRACE_AB=1): one more warm collect
+    # with the tracer forced OFF, same workload, same process. The
+    # tentpole's perf budget is on the DISABLED path: with no tracing,
+    # this collect must stay within BENCH_TRACE_GATE_PCT (default 2%)
+    # of the pre-PR warm-collect baseline when BENCH_BASELINE_WARM_S
+    # hands one in (e.g. collect_warm_s from the last pre-telemetry
+    # BENCH). trace_overhead_pct reports what tracing itself costs.
+    trace_ab = {}
+    if os.environ.get("BENCH_TRACE_AB") == "1":
+        tr = get_tracer()
+        was_enabled = tr.enabled
+        tr.disable()
+        # two untraced runs, min taken: single warm collects on this box
+        # scatter +/-2-3% run to run (the traced arm has measured FASTER
+        # than the untraced one), so one sample cannot support a 2% gate
+        notrace_runs = []
+        for _ in range(2):
+            t0 = time.time()
+            RefreshMessage.collect(msgs, keys[1].clone(), dks[1], (), tpu_cfg)
+            notrace_runs.append(time.time() - t0)
+        t_notrace = min(notrace_runs)
+        if was_enabled:
+            tr.enable()
+        log(
+            f"trace A/B: warm collect {t_tpu:.2f}s traced vs "
+            f"{t_notrace:.2f}s untraced (runs: "
+            f"{', '.join(f'{x:.2f}' for x in notrace_runs)})"
+        )
+        trace_ab = {
+            "collect_warm_notrace_s": round(t_notrace, 2),
+            "trace_overhead_pct": round(100 * (t_tpu - t_notrace) / t_notrace, 2),
+        }
+        base = os.environ.get("BENCH_BASELINE_WARM_S")
+        if base:
+            gate = float(os.environ.get("BENCH_TRACE_GATE_PCT", "2.0"))
+            base_s = float(base)
+            delta_pct = 100 * (t_notrace - base_s) / base_s
+            trace_ab["notrace_vs_baseline_pct"] = round(delta_pct, 2)
+            assert delta_pct <= gate, (
+                f"disabled-telemetry warm collect {t_notrace:.2f}s is "
+                f"{delta_pct:.1f}% over the pre-PR baseline {base_s:.2f}s "
+                f"(gate {gate}%)"
+            )
 
     # --- host baseline on a subsample (serial loop; linear extrapolation)
     # Two baselines: the native C++ Montgomery path (intops.mod_pow routes
@@ -772,7 +876,11 @@ def main():
         # warm-collect fold statistics of the randomized batch verifier
         # (FSDKR_RLC): fullwidth_ladders must read O(rlc_groups), not
         # O(rows_folded), and bisect_fallbacks 0 on honest transcripts
-        **rlc_fields(),
+        **rlc_out,
+        **trace_ab,
+        # the unified registry snapshot (schema-versioned): per-phase
+        # latency percentiles, pool/producer gauges, subsystem counters
+        **telemetry_out,
     }
     if trace_out:
         result["trace"] = trace_out  # warm-collect per-phase seconds
@@ -784,6 +892,7 @@ def main():
     if mfu_distribute:
         result["mfu_distribute"] = mfu_distribute
     emit(result)
+    telemetry_artifacts()
 
 
 if __name__ == "__main__":

@@ -183,33 +183,67 @@ def store_stats() -> Dict[str, int]:
 
 
 # ---------------------------------------------------------------------------
-# Engine statistics (bench.py emits these as the "crt" block)
+# Engine statistics (bench.py emits these as the "crt" block). Backed by
+# the process-global telemetry registry since ISSUE 6: one labeled
+# counter for the engine events, function gauges for the secret store's
+# occupancy (values never leave this module — only counts do).
 
-_STATS_LOCK = threading.Lock()
-_STATS = {
-    "rows": 0,            # rows routed through the CRT decomposition
-    "legs": 0,            # half-width legs computed (2 per row)
-    "fault_checks": 0,    # 64-bit-prime leg verifications performed
-    "fallback_rows": 0,   # rows that had to take the full-width path
-    "exp_bits_saved": 0,  # sum of exponent-width reduction over all legs
-}
+_EVENTS = (
+    "rows",            # rows routed through the CRT decomposition
+    "legs",            # half-width legs computed (2 per row)
+    "fault_checks",    # 64-bit-prime leg verifications performed
+    "fallback_rows",   # rows that had to take the full-width path
+    # ANALYTIC exponent-width reduction over all legs, priced from
+    # structural modulus widths (public-modulus bits minus leg bits per
+    # leg) — never from actual exponent bit-lengths, which are
+    # secret-derived (SECURITY.md "Telemetry discipline")
+    "exp_bits_saved",
+)
+
+
+def _metric():
+    from ..telemetry import registry
+
+    return registry.counter(
+        "fsdkr_crt_events",
+        "secret-CRT prover engine statistics (backend.crt)",
+        labelnames=("event",),
+    )
 
 
 def _count(**kw) -> None:
-    with _STATS_LOCK:
-        for k, v in kw.items():
-            _STATS[k] += v
+    m = _metric()
+    for k, v in kw.items():
+        m.inc(v, event=k)
 
 
 def crt_stats() -> Dict[str, int]:
-    with _STATS_LOCK:
-        return dict(_STATS)
+    m = _metric()
+    return {e: int(m.value(event=e)) for e in _EVENTS}
 
 
 def stats_reset() -> None:
-    with _STATS_LOCK:
-        for k in _STATS:
-            _STATS[k] = 0
+    _metric().reset()
+
+
+def _register_store_gauges() -> None:
+    from ..telemetry import registry
+
+    registry.gauge(
+        "fsdkr_crt_store_entries",
+        "CRT secret-store occupancy (contexts held; values never exported)",
+    ).set_function(lambda: _STORE.stats()["entries"])
+    registry.gauge(
+        "fsdkr_crt_store_hits",
+        "CRT secret-store lifetime hits",
+    ).set_function(lambda: _STORE.stats()["hits"])
+    registry.gauge(
+        "fsdkr_crt_store_misses",
+        "CRT secret-store lifetime misses",
+    ).set_function(lambda: _STORE.stats()["misses"])
+
+
+_register_store_gauges()
 
 
 # ---------------------------------------------------------------------------
@@ -266,7 +300,17 @@ def _leg_powm(bases: List[int], exps: List[int], mods: List[int]) -> List[int]:
     (run-grouped Montgomery constants, full wipe discipline), CPython
     pow as the last fallback."""
     from ..native import gmp
+    from ..utils.roofline import stamp_generic_host
+    from ..utils.trace import get_tracer
 
+    # CRT-phase roofline stamp: legs priced at the leg-MODULUS width
+    # (structurally half the public modulus) — the leg exponents are
+    # factorization-derived secrets and their true bit-lengths must not
+    # reach exported MAC counts (SECURITY.md "Telemetry discipline");
+    # reduced exponents are ~modulus-width anyway so the price is tight
+    if bases and get_tracer().enabled:
+        mod_bits = max(m.bit_length() for m in mods)
+        stamp_generic_host(len(bases), mod_bits, mod_bits)
     if gmp.available():
         return gmp.powm_batch(bases, exps, mods, secret=True)
     from .. import native
@@ -278,8 +322,9 @@ def _check_leg(base: int, exp: int, r: int, leg_value: int) -> None:
     """Bellcore fault check for one leg computed mod p_leg*r: the leg's
     residue mod r must equal the independently computed 64-bit Fermat
     reference pow(base mod r, exp mod (r-1), r) — exp is the ORIGINAL
-    unreduced exponent, so reduction-staging faults are caught too."""
-    _count(fault_checks=1)
+    unreduced exponent, so reduction-staging faults are caught too.
+    The fault_checks counter is maintained by the BATCH callers (one
+    registry touch per batch, not per leg — the hot-path rule)."""
     if leg_value % r != pow(base % r, exp % (r - 1), r):
         raise CrtFaultError()
 
@@ -364,6 +409,7 @@ def crt_modexp_batch(
     leg_b: List[int] = []
     leg_e: List[int] = []
     leg_m: List[int] = []
+    bits_saved = 0
     for leg in ("p", "q"):
         for i in crt_idx:
             ctx = contexts[i]
@@ -375,10 +421,18 @@ def crt_modexp_batch(
             leg_b.append(bases[i] % leg_mod)
             leg_e.append(red)
             leg_m.append(leg_mod)
-            _count(exp_bits_saved=max(
-                0, exps[i].bit_length() - red.bit_length()
-            ))
-    _count(rows=len(crt_idx), legs=2 * len(crt_idx))
+            # ANALYTIC savings from structural modulus widths only —
+            # the true exponent/reduced bit-lengths are secret-derived
+            # and must not reach the exported counter (SECURITY.md
+            # "Telemetry discipline"); accumulated locally so the
+            # registry is touched once per batch, not per leg
+            bits_saved += max(
+                0, ctx.modulus.bit_length() - leg_mod.bit_length()
+            )
+    _count(
+        rows=len(crt_idx), legs=2 * len(crt_idx),
+        fault_checks=2 * len(crt_idx), exp_bits_saved=bits_saved,
+    )
 
     res = _leg_powm(leg_b, leg_e, leg_m)
     k = len(crt_idx)
@@ -418,18 +472,19 @@ def crt_powm_shared(
     from .. import native
 
     legs = []
+    bits_saved = 0
     for leg_mod0, d in ((ctx.p_leg, ctx.d_p), (ctx.q_leg, ctx.d_q)):
         leg_mod = leg_mod0 * r
         lcm = d // math.gcd(d, r1) * r1
         red = [e % lcm for e in exps]
-        _count(exp_bits_saved=sum(
-            max(0, e.bit_length() - x.bit_length())
-            for e, x in zip(exps, red)
-        ))
+        # analytic, structural-width savings (see crt_modexp_batch)
+        bits_saved += m * max(
+            0, ctx.modulus.bit_length() - leg_mod.bit_length()
+        )
         legs.append(
             native.modexp_shared(base % leg_mod, red, leg_mod, cache=False)
         )
-    _count(rows=m, legs=2 * m)
+    _count(rows=m, legs=2 * m, fault_checks=2 * m, exp_bits_saved=bits_saved)
     return [
         _recombine_checked(base, e, r, sp, sq, ctx)
         for e, sp, sq in zip(exps, legs[0], legs[1])
@@ -448,6 +503,6 @@ def fault_checked_powm(base: int, exp: int, leg_mod: int) -> int:
         raise ValueError("fault_checked_powm needs a unit base, exp >= 0")
     r = _fresh_check_prime([base])
     (v,) = _leg_powm([base % (leg_mod * r)], [exp], [leg_mod * r])
-    _count(legs=1)
+    _count(legs=1, fault_checks=1)
     _check_leg(base, exp, r, v)
     return v % leg_mod

@@ -110,6 +110,21 @@ def host_powm(bases, exps, moduli) -> List[int]:
     from .. import native
     from ..native import gmp
 
+    if bases:
+        # prover/precompute roofline stamp: the device launches have
+        # stamped since round 2; the host engines carry the same
+        # analytic pricing so per-phase mfu() covers the prover columns
+        # too. Exponents are priced at the MODULUS width: actual
+        # exponent bit-lengths are secret-derived on prover paths and
+        # must not influence exported MAC counts (SECURITY.md
+        # "Telemetry discipline"); the enabled-gate also keeps the
+        # O(rows) width scan off the untraced hot path.
+        from ..utils.roofline import stamp_generic_host
+        from ..utils.trace import get_tracer
+
+        if get_tracer().enabled:
+            mod_bits = max(m.bit_length() for m in moduli)
+            stamp_generic_host(len(bases), mod_bits, mod_bits)
     if gmp.available():
         return gmp.powm_batch(list(bases), list(exps), list(moduli))
     return native.modexp_batch(list(bases), list(exps), list(moduli))
@@ -458,7 +473,15 @@ def _joint_rows(bases_rows, exps_rows, moduli, device: bool) -> List[int]:
             res = _device_joint_launch(b, e, m, k)
         else:
             from .. import native
+            from ..utils.roofline import stamp_generic_host
+            from ..utils.trace import get_tracer
 
+            # host joint ladder: one shared squaring chain per row —
+            # priced at the modulus width (exponent widths may be
+            # secret-derived; see SECURITY.md "Telemetry discipline")
+            if get_tracer().enabled:
+                mod_bits = max(mi.bit_length() for mi in m)
+                stamp_generic_host(len(b), mod_bits, mod_bits)
             res = native.multi_modexp_batch(b, e, m)
         for i, v in zip(idxs, res):
             out[i] = v

@@ -43,7 +43,6 @@ from __future__ import annotations
 
 import os
 import secrets
-import threading
 from typing import Callable, Dict, List, Sequence
 
 __all__ = [
@@ -82,39 +81,36 @@ def sample_rhos(count: int) -> List[int]:
 # groups folded, how many per-row equations they absorbed, how many
 # full-width ladders the folded plan still launches (the O(1)-per-group
 # count the fold exists to achieve), and how many groups fell back to
-# bisection. Process-wide with a lock: collect() fans launches out over
-# the pipeline thread pool.
+# bisection. Since ISSUE 6 the backing store is the process-global
+# telemetry registry (one labeled counter); `stats()`/`stats_reset()`
+# remain the legacy window view bench.py and the tests use.
 
-_LOCK = threading.Lock()
-_STATS: Dict[str, int] = {}
-
-
-def _zero() -> Dict[str, int]:
-    return {
-        "rlc_groups": 0,
-        "rows_folded": 0,
-        "fullwidth_ladders": 0,
-        "bisect_fallbacks": 0,
-    }
+_EVENTS = (
+    "rlc_groups", "rows_folded", "fullwidth_ladders", "bisect_fallbacks",
+)
 
 
-_STATS = _zero()
+def _metric():
+    from ..telemetry import registry
+
+    return registry.counter(
+        "fsdkr_rlc_events",
+        "randomized-batch-verification fold statistics (backend.rlc)",
+        labelnames=("event",),
+    )
 
 
 def count(name: str, n: int = 1) -> None:
-    with _LOCK:
-        _STATS[name] = _STATS.get(name, 0) + n
+    _metric().inc(n, event=name)
 
 
 def stats() -> Dict[str, int]:
-    with _LOCK:
-        return dict(_STATS)
+    m = _metric()
+    return {e: int(m.value(event=e)) for e in _EVENTS}
 
 
 def stats_reset() -> None:
-    global _STATS
-    with _LOCK:
-        _STATS = _zero()
+    _metric().reset()
 
 
 # ---------------------------------------------------------------------------

@@ -25,19 +25,31 @@ __all__ = [
 # is a randomized algorithm with geometric-tail work, so wall-clock
 # comparisons between two keygen runs are meaningless without
 # normalizing by the work actually drawn — candidates sieved and
-# Miller-Rabin rounds requested. Counting is unsynchronized-increment
-# (the generation pipeline is driven from one thread per batch; a torn
-# read would only perturb a diagnostic).
-_GEN_STATS = {"candidates": 0, "mr_rounds": 0}
+# Miller-Rabin rounds requested. Backed by the process-global telemetry
+# registry since ISSUE 6 (one labeled counter); increments are batched
+# per candidate window, so the counter lock is cold.
+
+
+def _gen_metric():
+    from ..telemetry import registry
+
+    return registry.counter(
+        "fsdkr_primegen_events",
+        "prime-search work drawn (candidates sieved / MR rounds requested)",
+        labelnames=("event",),
+    )
 
 
 def gen_stats() -> dict:
-    return dict(_GEN_STATS)
+    m = _gen_metric()
+    return {
+        "candidates": int(m.value(event="candidates")),
+        "mr_rounds": int(m.value(event="mr_rounds")),
+    }
 
 
 def gen_stats_reset() -> None:
-    for k in _GEN_STATS:
-        _GEN_STATS[k] = 0
+    _gen_metric().reset()
 
 # Product of odd primes below 4000 — one gcd against a candidate rejects
 # nearly all composites before any modexp is spent on Miller-Rabin.
@@ -203,15 +215,16 @@ def gen_primes_batch(bits: int, count: int) -> list:
             )
             if gmp.gcd(c, sieve) == 1:
                 cands.append(c)
-        _GEN_STATS["candidates"] += len(cands)
+        gen = _gen_metric()
+        gen.inc(len(cands), event="candidates")
         # one cheap round first: almost every sieved composite dies here
         pre = _mr_batch(cands, 1)
-        _GEN_STATS["mr_rounds"] += len(cands)
+        gen.inc(len(cands), event="mr_rounds")
         survivors = [c for c, v in zip(cands, pre) if v]
         if not survivors:
             continue
         conf = _mr_batch(survivors, 29)
-        _GEN_STATS["mr_rounds"] += 29 * len(survivors)
+        gen.inc(29 * len(survivors), event="mr_rounds")
         found += [c for c, v in zip(survivors, conf) if v]
     return found[:count]
 

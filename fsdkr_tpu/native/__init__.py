@@ -310,6 +310,15 @@ def modexp_shared(
     or the modulus is even/oversized."""
     if not exps:
         return []
+    from ..utils.roofline import stamp_shared_host
+    from ..utils.trace import get_tracer
+
+    # prover-comb roofline stamp: the host comb carries the same
+    # analytic pricing as the device comb kernel, with exponents priced
+    # at the (public) modulus width — actual widths are secret-derived
+    # on prover paths (SECURITY.md "Telemetry discipline")
+    if get_tracer().enabled:
+        stamp_shared_host(1, len(exps), mod.bit_length(), mod.bit_length())
     lib = _get()
     L = _limbs_for(mod)
     if lib is None or L > _MAX_LIMBS or mod % 2 == 0 or mod <= 1:

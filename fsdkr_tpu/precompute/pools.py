@@ -139,24 +139,43 @@ class PoolEntry:
         self._values = None
 
 
+def _events():
+    """Pool event counter in the process-global telemetry registry,
+    labeled by event AND pool kind (the per-kind split is new with
+    ISSUE 6 — a dry 'keys' pool and a dry 'enc' pool have very
+    different costs); `precompute_stats()` sums kinds for the legacy
+    view."""
+    from ..telemetry import registry
+
+    return registry.counter(
+        "fsdkr_pool_events",
+        "precompute pool events (produced/consumed/dry_fallbacks/wiped)",
+        labelnames=("event", "kind"),
+    )
+
+
+def _bytes_gauge():
+    from ..telemetry import registry
+
+    return registry.gauge(
+        "fsdkr_pool_bytes",
+        "total bytes currently pooled (budget: FSDKR_POOL_BUDGET_MB)",
+    )
+
+
 class PrecomputeStore:
     """Per-session store of pools keyed by (kind, key). Bounded by
     per-key depth and a total byte budget; FIFO within a pool so
     consumption order matches production order (the seeded-parity
     contract). Thread-safe: the background producer puts while
-    distribute() takes."""
+    distribute() takes. Event counts live in the telemetry registry
+    (labeled by kind); only counts are exported — entry VALUES never
+    leave this module (SECURITY.md "Telemetry discipline")."""
 
     def __init__(self):
         self._pools: Dict[Tuple, deque] = OrderedDict()
         self._lock = threading.RLock()
         self._bytes = 0
-        self.stats = {
-            "produced": 0,
-            "consumed": 0,
-            "dry_fallbacks": 0,
-            "wiped": 0,
-            "bytes_pooled": 0,
-        }
 
     # -- consumption ----------------------------------------------------
     def take(self, kind: str, key) -> Optional[tuple]:
@@ -166,12 +185,12 @@ class PrecomputeStore:
         with self._lock:
             pool = self._pools.get((kind, key))
             if not pool:
-                self.stats["dry_fallbacks"] += 1
+                _events().inc(event="dry_fallbacks", kind=kind)
                 return None
             ent = pool.popleft()
             self._bytes -= ent.nbytes
-            self.stats["consumed"] += 1
-            self.stats["bytes_pooled"] = self._bytes
+            _events().inc(event="consumed", kind=kind)
+            _bytes_gauge().set(self._bytes)
         return ent.take()
 
     # -- production -----------------------------------------------------
@@ -186,12 +205,12 @@ class PrecomputeStore:
                 or self._bytes + ent.nbytes > _pool_budget_bytes()
             ):
                 ent.wipe()
-                self.stats["wiped"] += 1
+                _events().inc(event="wiped", kind=kind)
                 return False
             pool.append(ent)
             self._bytes += ent.nbytes
-            self.stats["produced"] += 1
-            self.stats["bytes_pooled"] = self._bytes
+            _events().inc(event="produced", kind=kind)
+            _bytes_gauge().set(self._bytes)
             return True
 
     def depth(self, kind: str, key) -> int:
@@ -218,35 +237,52 @@ class PrecomputeStore:
             for ent in pool:
                 self._bytes -= ent.nbytes
                 ent.wipe()
-                self.stats["wiped"] += 1
+                _events().inc(event="wiped", kind=kind)
             pool.clear()
-            self.stats["bytes_pooled"] = self._bytes
+            _bytes_gauge().set(self._bytes)
 
     def clear(self) -> None:
         """Wipe every unconsumed entry (session teardown, tests, A/B)."""
         with self._lock:
-            for pool in self._pools.values():
+            for (kind, _key), pool in self._pools.items():
                 for ent in pool:
                     ent.wipe()
-                    self.stats["wiped"] += 1
+                    _events().inc(event="wiped", kind=kind)
                 pool.clear()
             self._pools.clear()
             self._bytes = 0
-            self.stats["bytes_pooled"] = 0
+            _bytes_gauge().set(0)
+
+    def depths_by_kind(self) -> Dict[str, int]:
+        """Entries currently pooled, summed per kind (the pool-depth
+        gauge the SLO/serving work targets)."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for (kind, _key), pool in self._pools.items():
+                out[kind] = out.get(kind, 0) + len(pool)
+        return out
 
     def snapshot(self) -> Dict[str, int]:
+        m = _events()
+        sums = {
+            e: 0.0 for e in ("produced", "consumed", "dry_fallbacks", "wiped")
+        }
+        for rec in m.snapshot_values():
+            ev = rec["labels"].get("event")
+            if ev in sums:
+                sums[ev] += rec["value"]
         with self._lock:
             return {
-                **self.stats,
+                **{k: int(v) for k, v in sums.items()},
+                "bytes_pooled": self._bytes,
                 "entries": sum(len(p) for p in self._pools.values()),
                 "pools": len(self._pools),
             }
 
     def stats_reset(self) -> None:
+        _events().reset()
         with self._lock:
-            for k in self.stats:
-                self.stats[k] = 0
-            self.stats["bytes_pooled"] = self._bytes
+            _bytes_gauge().set(self._bytes)
 
     def secret_values(self) -> List[int]:
         """Every int currently pooled, recursing into proof/statement/
@@ -283,6 +319,25 @@ class PrecomputeStore:
 
 
 _STORE = PrecomputeStore()
+
+
+def _register_gauges() -> None:
+    from ..telemetry import registry
+
+    registry.gauge(
+        "fsdkr_pool_depth",
+        "entries currently pooled, per kind (pool-occupancy gauge)",
+        labelnames=("kind",),
+    ).set_labeled_function(
+        lambda: {(k,): v for k, v in _STORE.depths_by_kind().items()}
+    )
+    registry.gauge(
+        "fsdkr_pool_count",
+        "distinct (kind, key) pools currently held",
+    ).set_function(lambda: len(_STORE._pools))
+
+
+_register_gauges()
 
 
 def get_store() -> PrecomputeStore:

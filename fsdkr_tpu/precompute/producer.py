@@ -113,29 +113,35 @@ def produce_keys(params: tuple, count: int) -> List[tuple]:
 def produce_for(kind: str, key, count: int) -> int:
     """Produce and pool up to `count` entries of (kind, key); returns
     how many the pool absorbed. Keys are self-describing: every value
-    production needs is in the (public) pool key."""
+    production needs is in the (public) pool key. Each production bout
+    is a span (`precompute.produce.<kind>`): on the background thread
+    these are the producer's own track in the Chrome-trace timeline —
+    the occupancy picture the offline/online split is tuned by."""
     if count <= 0:
         return 0
-    if kind == "enc":
-        entries = produce_enc(key, count)
-    elif kind == "pdl":
-        from ..proofs.pdl_slack import PDLwSlackProof
+    from ..utils.trace import phase
 
-        h1, h2, nt, n = key
-        entries = PDLwSlackProof.produce_stage1(h1, h2, nt, n, count)
-    elif kind == "alice":
-        from ..proofs.alice_range import AliceProof
+    with phase(f"precompute.produce.{kind}", items=count):
+        if kind == "enc":
+            entries = produce_enc(key, count)
+        elif kind == "pdl":
+            from ..proofs.pdl_slack import PDLwSlackProof
 
-        h1, h2, nt, n = key
-        entries = AliceProof.produce_stage1(h1, h2, nt, n, count)
-    elif kind == "keys":
-        entries = produce_keys(key, count)
-    else:
-        raise ValueError(f"unknown pool kind {kind!r}")
-    stored = 0
-    for e in entries:
-        if pools.put(kind, key, e):
-            stored += 1
+            h1, h2, nt, n = key
+            entries = PDLwSlackProof.produce_stage1(h1, h2, nt, n, count)
+        elif kind == "alice":
+            from ..proofs.alice_range import AliceProof
+
+            h1, h2, nt, n = key
+            entries = AliceProof.produce_stage1(h1, h2, nt, n, count)
+        elif kind == "keys":
+            entries = produce_keys(key, count)
+        else:
+            raise ValueError(f"unknown pool kind {kind!r}")
+        stored = 0
+        for e in entries:
+            if pools.put(kind, key, e):
+                stored += 1
     return stored
 
 
@@ -224,9 +230,15 @@ def _step() -> bool:
     parks until the next kick."""
     if not background_enabled():
         return False
+    from ..utils.trace import phase
+
     for kind, key, room in _deficits():
         cap = _KEY_BATCH if kind == "keys" else _PAIR_BATCH
-        if produce_for(kind, key, min(room, cap)) > 0:
+        # the step span is the producer thread's unit of work in the
+        # timeline; produce_for opens the per-kind child span under it
+        with phase("precompute.producer.step"):
+            produced = produce_for(kind, key, min(room, cap))
+        if produced > 0:
             return True
     return False
 
@@ -238,6 +250,34 @@ def _producer():
 
         _PRODUCER = BackgroundProducer(_step)
     return _PRODUCER
+
+
+def _register_gauges() -> None:
+    """Producer-occupancy telemetry: productive fraction of the
+    background thread's wall clock (the producer/consumer balance the
+    SZKP-style pipelining tunes), plus lifetime step/error counts. All
+    read lazily at snapshot time; zeros before the first kick."""
+    from ..telemetry import registry
+
+    registry.gauge(
+        "fsdkr_producer_occupancy",
+        "background producer busy-fraction since first start (0..1)",
+    ).set_function(lambda: _PRODUCER.occupancy() if _PRODUCER else 0.0)
+    registry.gauge(
+        "fsdkr_producer_busy_seconds",
+        "background producer cumulative productive seconds",
+    ).set_function(lambda: _PRODUCER.busy_seconds if _PRODUCER else 0.0)
+    registry.gauge(
+        "fsdkr_producer_steps",
+        "background producer lifetime productive steps",
+    ).set_function(lambda: _PRODUCER.steps if _PRODUCER else 0)
+    registry.gauge(
+        "fsdkr_producer_errors",
+        "background producer lifetime step exceptions",
+    ).set_function(lambda: _PRODUCER.errors if _PRODUCER else 0)
+
+
+_register_gauges()
 
 
 def kick() -> None:

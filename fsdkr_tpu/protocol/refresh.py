@@ -104,6 +104,26 @@ class RefreshMessage:
         SURVEY.md §1. `distribute` is the single-sender special case.
         Mutates each local_key.vss_scheme.
         """
+        from ..utils.trace import phase
+
+        # the root prover span: every distribute.* phase (and the engine
+        # tile spans they fan out) nests under it in the trace timeline
+        with phase(
+            "distribute",
+            items=len(senders) * new_n,
+            senders=len(senders),
+            new_n=new_n,
+        ):
+            return RefreshMessage._distribute_batch_impl(
+                senders, new_n, config
+            )
+
+    @staticmethod
+    def _distribute_batch_impl(
+        senders: Sequence[Tuple[int, LocalKey]],
+        new_n: int,
+        config: ProtocolConfig = DEFAULT_CONFIG,
+    ) -> List[Tuple["RefreshMessage", DecryptionKey]]:
         from ..backend.powm import get_batch_powm
         from .. import precompute
 
@@ -600,6 +620,18 @@ class RefreshMessage:
         or the exception `collect` would have raised (a failing session
         never blocks the others).
         """
+        from ..utils.trace import phase
+
+        # the root verifier span; the collect.* family phases
+        # (TracedVerifier) and their engine tiles nest under it
+        with phase("collect", items=len(sessions), sessions=len(sessions)):
+            return RefreshMessage._collect_sessions_impl(sessions, config)
+
+    @staticmethod
+    def _collect_sessions_impl(
+        sessions,
+        config: ProtocolConfig = DEFAULT_CONFIG,
+    ) -> List[Optional[Exception]]:
         backend = get_backend(config)
         # idle-time pool refill (FSDKR_PRECOMPUTE): verification's
         # native/GMP launches release the GIL, so the background
@@ -758,27 +790,30 @@ class RefreshMessage:
                     errors[s] = RingPedersenProofError()
 
         # ---- share recovery inputs (reference :367-373) ---------------
+        from ..utils.trace import phase
+
         sums: Dict[int, tuple] = {}
-        for s in alive():
-            msgs, key, _dk, _joins = sessions[s]
-            try:
-                old_ek = key.paillier_key_vec[key.i - 1]
-                cipher_sum, li_vec = RefreshMessage.get_ciphertext_sum(
-                    msgs, key.i, key.vss_scheme.parameters, old_ek
-                )
-                # Hardening absent from the reference: the Lagrange
-                # weights must re-derive the unchanged group key, or a
-                # lying/duplicated old_party_index silently rotates the
-                # committee onto a DIFFERENT secret (see
-                # interpolate_constant_term).
-                y_check = RefreshMessage.interpolate_constant_term(
-                    msgs, li_vec, key.t
-                )
-                if y_check != key.y_sum_s:
-                    raise PublicShareValidationError()
-                sums[s] = (old_ek, cipher_sum, li_vec)
-            except Exception as e:
-                errors[s] = e
+        with phase("collect.share_recovery", items=len(alive())):
+            for s in alive():
+                msgs, key, _dk, _joins = sessions[s]
+                try:
+                    old_ek = key.paillier_key_vec[key.i - 1]
+                    cipher_sum, li_vec = RefreshMessage.get_ciphertext_sum(
+                        msgs, key.i, key.vss_scheme.parameters, old_ek
+                    )
+                    # Hardening absent from the reference: the Lagrange
+                    # weights must re-derive the unchanged group key, or a
+                    # lying/duplicated old_party_index silently rotates the
+                    # committee onto a DIFFERENT secret (see
+                    # interpolate_constant_term).
+                    y_check = RefreshMessage.interpolate_constant_term(
+                        msgs, li_vec, key.t
+                    )
+                    if y_check != key.y_sum_s:
+                        raise PublicShareValidationError()
+                    sums[s] = (old_ek, cipher_sum, li_vec)
+                except Exception as e:
+                    errors[s] = e
 
         # ---- Paillier correct-key + composite dlog, fused -------------
         ck_items: list = []
@@ -824,64 +859,65 @@ class RefreshMessage:
         # (mutating phase; order and mutation points match collect /
         # reference :375-467 — a failure mid-way leaves the same partial
         # paillier_key_vec updates the reference would)
-        for s in alive():
-            msgs, local_key, new_dk, joins = sessions[s]
-            new_n = new_ns[s]
-            ck0, d0 = ck_spans[s][0], dlog_spans[s][0]
-            try:
-                for k, msg in enumerate(msgs):
-                    if not ck_verdicts[ck0 + k]:
-                        raise PaillierVerificationError(party_index=msg.party_index)
-                    n_len = msg.ek.n.bit_length()
-                    if n_len > config.paillier_bits or n_len < config.paillier_bits - 1:
-                        raise ModuliTooSmall(
-                            party_index=msg.party_index, moduli_size=n_len
-                        )
-                    local_key.paillier_key_vec[msg.party_index - 1] = msg.ek
+        with phase("collect.adopt", items=len(alive())):
+            for s in alive():
+                msgs, local_key, new_dk, joins = sessions[s]
+                new_n = new_ns[s]
+                ck0, d0 = ck_spans[s][0], dlog_spans[s][0]
+                try:
+                    for k, msg in enumerate(msgs):
+                        if not ck_verdicts[ck0 + k]:
+                            raise PaillierVerificationError(party_index=msg.party_index)
+                        n_len = msg.ek.n.bit_length()
+                        if n_len > config.paillier_bits or n_len < config.paillier_bits - 1:
+                            raise ModuliTooSmall(
+                                party_index=msg.party_index, moduli_size=n_len
+                            )
+                        local_key.paillier_key_vec[msg.party_index - 1] = msg.ek
 
-                for k, join in enumerate(joins):
-                    party_index = join.get_party_index()
-                    if not ck_verdicts[ck0 + len(msgs) + k]:
-                        raise PaillierVerificationError(party_index=party_index)
-                    if not (dlog_verdicts[d0 + 2 * k] and dlog_verdicts[d0 + 2 * k + 1]):
-                        raise DLogProofValidation(party_index=party_index)
-                    n_len = join.ek.n.bit_length()
-                    if n_len > config.paillier_bits or n_len < config.paillier_bits - 1:
-                        raise ModuliTooSmall(
-                            party_index=party_index, moduli_size=n_len
-                        )
-                    local_key.paillier_key_vec[party_index - 1] = join.ek
+                    for k, join in enumerate(joins):
+                        party_index = join.get_party_index()
+                        if not ck_verdicts[ck0 + len(msgs) + k]:
+                            raise PaillierVerificationError(party_index=party_index)
+                        if not (dlog_verdicts[d0 + 2 * k] and dlog_verdicts[d0 + 2 * k + 1]):
+                            raise DLogProofValidation(party_index=party_index)
+                        n_len = join.ek.n.bit_length()
+                        if n_len > config.paillier_bits or n_len < config.paillier_bits - 1:
+                            raise ModuliTooSmall(
+                                party_index=party_index, moduli_size=n_len
+                            )
+                        local_key.paillier_key_vec[party_index - 1] = join.ek
 
-                # ---- decrypt own new share; rotate key material -------
-                old_ek, cipher_sum, li_vec = sums[s]
-                new_share = paillier.decrypt(
-                    local_key.paillier_dk, old_ek, cipher_sum
-                )
-                new_share_fe = Scalar.from_int(new_share)
+                    # ---- decrypt own new share; rotate key material -------
+                    old_ek, cipher_sum, li_vec = sums[s]
+                    new_share = paillier.decrypt(
+                        local_key.paillier_dk, old_ek, cipher_sum
+                    )
+                    new_share_fe = Scalar.from_int(new_share)
 
-                # pk_vec rebuild by assignment — conscious fix of quirk 1
-                # (reference :455-464 uses Vec::insert)
-                pk_vec = combine_committed_points(
-                    msgs, li_vec, local_key.t, new_n,
-                    use_device=config.device_ec,
-                )
+                    # pk_vec rebuild by assignment — conscious fix of quirk 1
+                    # (reference :455-464 uses Vec::insert)
+                    pk_vec = combine_committed_points(
+                        msgs, li_vec, local_key.t, new_n,
+                        use_device=config.device_ec,
+                    )
 
-                # consistency gate absent from the reference: the decrypted
-                # share must match the Feldman-committed public share, or
-                # the key would be silently corrupted (e.g. by a plaintext
-                # wrap mod a too-small Paillier modulus)
-                if GENERATOR * new_share_fe != pk_vec[local_key.i - 1]:
-                    raise PublicShareValidationError()
+                    # consistency gate absent from the reference: the decrypted
+                    # share must match the Feldman-committed public share, or
+                    # the key would be silently corrupted (e.g. by a plaintext
+                    # wrap mod a too-small Paillier modulus)
+                    if GENERATOR * new_share_fe != pk_vec[local_key.i - 1]:
+                        raise PublicShareValidationError()
 
-                # zeroize the old dk, install the new one (reference :445-448)
-                local_key.paillier_dk.zeroize()
-                local_key.paillier_dk = new_dk
+                    # zeroize the old dk, install the new one (reference :445-448)
+                    local_key.paillier_dk.zeroize()
+                    local_key.paillier_dk = new_dk
 
-                local_key.keys_linear.x_i = new_share_fe
-                local_key.keys_linear.y = GENERATOR * new_share_fe
-                local_key.pk_vec = pk_vec
-            except Exception as e:
-                errors[s] = e
+                    local_key.keys_linear.x_i = new_share_fe
+                    local_key.keys_linear.y = GENERATOR * new_share_fe
+                    local_key.pk_vec = pk_vec
+                except Exception as e:
+                    errors[s] = e
         return errors
 
 

@@ -1,0 +1,90 @@
+"""Unified telemetry for the refresh pipeline (ISSUE 6).
+
+Four pieces:
+
+- `spans`    — hierarchical spans behind the back-compatible tracer
+               (`get_tracer()`, `phase(...)`); Chrome-trace/Perfetto
+               export via FSDKR_TRACE_OUT.
+- `registry` — the process-global labeled metrics registry (counters /
+               gauges / fixed-bucket histograms with interpolated
+               p50/p95/p99); the five legacy per-subsystem stat blocks
+               are views over it.
+- `export`   — schema-versioned JSON snapshot (the `telemetry` key in
+               every bench JSON) + Prometheus text exposition via
+               FSDKR_METRICS_DUMP.
+- `flight`   — always-on bounded flight recorder, flushed on unhandled
+               exception / SIGTERM when FSDKR_FLIGHT names a
+               destination.
+
+Secrecy rule (SECURITY.md "Telemetry discipline"): span attributes,
+metric labels, and flight-event fields accept allowlisted small scalars
+only — never pool entries, rho coefficients, CRT contexts, or witness
+material. Wide integers are rejected at the API boundary.
+
+This package imports neither jax nor the native bridge: it must be
+importable (and cheap) everywhere, including the flight-recorder crash
+path.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+
+from . import export, flight, registry  # noqa: F401
+from .registry import (  # noqa: F401
+    SCHEMA_VERSION,
+    counter,
+    gauge,
+    get_registry,
+    histogram,
+)
+from .spans import (  # noqa: F401
+    PhaseStats,
+    Span,
+    Tracer,
+    get_tracer,
+    jax_profile,
+    phase,
+)
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "PhaseStats",
+    "Span",
+    "Tracer",
+    "get_tracer",
+    "phase",
+    "jax_profile",
+    "counter",
+    "gauge",
+    "histogram",
+    "get_registry",
+    "export",
+    "flight",
+    "registry",
+]
+
+# crash-path handlers: only when FSDKR_FLIGHT names a destination
+flight.install()
+
+
+def _atexit_exports() -> None:
+    """Best-effort export at interpreter exit so a run that simply ends
+    (no bench harness driving explicit writes) still leaves its
+    artifacts when the env vars ask for them."""
+    try:
+        path = os.environ.get("FSDKR_TRACE_OUT")
+        tr = get_tracer()
+        if path and tr.spans():
+            tr.write_chrome_trace(path)
+    except Exception:
+        pass
+    try:
+        export.maybe_dump_metrics()
+    except Exception:
+        pass
+
+
+if os.environ.get("FSDKR_TRACE_OUT") or os.environ.get("FSDKR_METRICS_DUMP"):
+    atexit.register(_atexit_exports)

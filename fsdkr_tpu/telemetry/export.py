@@ -1,0 +1,117 @@
+"""Telemetry export: the schema-versioned JSON snapshot (embedded in
+every bench JSON under the single `telemetry` key) and Prometheus text
+exposition (`FSDKR_METRICS_DUMP=path`).
+
+The JSON snapshot IS `registry.Registry.snapshot()` — one schema, one
+read path; bench.py stopped hand-harvesting the five per-subsystem stat
+dicts (those remain as legacy keys for old-BENCH comparability, but they
+are views over the same registry metrics now).
+
+Prometheus exposition follows the text format v0.0.4: counters get a
+`_total`-suffixed sample when the name doesn't already carry one,
+histograms emit cumulative `_bucket{le=...}` samples plus `_sum` and
+`_count`, and function gauges are evaluated at dump time.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .registry import SCHEMA_VERSION, get_registry
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "snapshot",
+    "prometheus_text",
+    "dump_metrics",
+    "maybe_dump_metrics",
+]
+
+
+def snapshot() -> dict:
+    """The one structured telemetry read (schema-versioned)."""
+    return get_registry().snapshot()
+
+
+def _fmt_labels(labels: dict, extra: Optional[tuple] = None) -> str:
+    parts = [
+        f'{k}="{_escape(str(v))}"' for k, v in labels.items()
+    ]
+    if extra is not None:
+        parts.append(f'{extra[0]}="{_escape(str(extra[1]))}"')
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def prometheus_text(snap: Optional[dict] = None) -> str:
+    """Render a snapshot (default: the live registry) as Prometheus text
+    exposition."""
+    snap = snap or snapshot()
+    lines = [f"# fsdkr telemetry schema {snap.get('schema', '?')}"]
+    for name, m in sorted(snap.get("metrics", {}).items()):
+        kind = m.get("type", "untyped")
+        sample_name = name
+        if kind == "counter" and not name.endswith("_total"):
+            sample_name = name + "_total"
+        if m.get("help"):
+            lines.append(f"# HELP {sample_name} {_escape(m['help'])}")
+        lines.append(f"# TYPE {sample_name} {kind}")
+        for rec in m.get("values", []):
+            labels = rec.get("labels", {})
+            if kind == "histogram":
+                cum = 0
+                for le, cum in rec.get("buckets", []):
+                    lines.append(
+                        f"{name}_bucket{_fmt_labels(labels, ('le', le))} "
+                        f"{_fmt_value(cum)}"
+                    )
+                lines.append(
+                    f"{name}_bucket{_fmt_labels(labels, ('le', '+Inf'))} "
+                    f"{_fmt_value(rec.get('count', 0))}"
+                )
+                lines.append(
+                    f"{name}_sum{_fmt_labels(labels)} "
+                    f"{_fmt_value(rec.get('sum', 0.0))}"
+                )
+                lines.append(
+                    f"{name}_count{_fmt_labels(labels)} "
+                    f"{_fmt_value(rec.get('count', 0))}"
+                )
+            else:
+                lines.append(
+                    f"{sample_name}{_fmt_labels(labels)} "
+                    f"{_fmt_value(rec.get('value', 0.0))}"
+                )
+    return "\n".join(lines) + "\n"
+
+
+def dump_metrics(path: str) -> str:
+    """Write the Prometheus exposition to `path` (atomic replace)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(prometheus_text())
+    os.replace(tmp, path)
+    return path
+
+
+def maybe_dump_metrics() -> Optional[str]:
+    """Dump to FSDKR_METRICS_DUMP when set; the bench flows call this
+    after their measured sections, and the package atexit hook calls it
+    once more at interpreter exit (last write wins — a superset)."""
+    path = os.environ.get("FSDKR_METRICS_DUMP")
+    if not path:
+        return None
+    try:
+        return dump_metrics(path)
+    except OSError:
+        return None

@@ -1,0 +1,276 @@
+"""Always-on flight recorder: a bounded ring buffer of the last N span
+events and counter deltas, flushed to disk on unhandled exception or
+SIGTERM.
+
+Motivation (ISSUE 6 / ROADMAP item 2): the on-chip tunnel windows are
+~4 minutes and have died mid-battery repeatedly; a run that dies
+mid-step currently leaves no artifact at all. The recorder costs one
+deque append per phase/counter event (deque with maxlen — appends are
+atomic under the GIL, no lock on the hot path), so it stays on even
+with tracing disabled.
+
+`FSDKR_FLIGHT` controls the dump destination only, never the recording:
+  - unset/`0`  — record, never auto-dump (explicit `dump(path)` works)
+  - `1`        — dump to `fsdkr_flight_<pid>.json` in the CWD
+  - a path     — dump there
+
+`install()` (called by the package __init__ when FSDKR_FLIGHT is set)
+chains `sys.excepthook` and the SIGTERM handler: both write the dump and
+then defer to the previous handler / default behavior, so the process
+still dies the way it would have — it just leaves a postmortem.
+
+Events never carry operand material: the payload is the same allowlisted
+scalars the span/metric layer accepts (SECURITY.md "Telemetry
+discipline").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import sys
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = [
+    "FlightRecorder",
+    "get_flight",
+    "record",
+    "dump",
+    "install",
+    "FLIGHT_SCHEMA",
+]
+
+FLIGHT_SCHEMA = "fsdkr-flight/1"
+
+
+def _cap() -> int:
+    try:
+        return max(64, int(os.environ.get("FSDKR_FLIGHT_EVENTS", "4096")))
+    except ValueError:
+        return 4096
+
+
+def _sanitize(fields: Dict[str, object]) -> Optional[Dict[str, object]]:
+    """Allowlisted scalars only (the shared registry.sanitize_fields
+    rule); a disallowed value is dropped silently — the recorder must
+    never raise on the hot path, and a wide int is exactly what must
+    not land in a postmortem file."""
+    from .registry import sanitize_fields
+
+    return sanitize_fields(fields)[0]
+
+
+class FlightRecorder:
+    def __init__(self, cap: Optional[int] = None):
+        self._events: deque = deque(maxlen=cap or _cap())
+        self._recorded = 0  # lifetime count (ring only keeps the tail)
+        self._t0 = time.time()
+
+    def record(
+        self,
+        kind: str,
+        name: str,
+        dur: Optional[float] = None,
+        **fields,
+    ) -> None:
+        th = threading.current_thread()
+        self._recorded += 1  # benign race: diagnostic counter
+        self._events.append(
+            (
+                time.time(),
+                th.name,
+                kind,
+                name,
+                None if dur is None else round(dur, 6),
+                _sanitize(fields),
+            )
+        )
+
+    def snapshot(self) -> List[dict]:
+        out = []
+        for ts, thread, kind, name, dur, fields in list(self._events):
+            rec = {
+                "ts": round(ts, 6),
+                "thread": thread,
+                "kind": kind,
+                "name": name,
+            }
+            if dur is not None:
+                rec["dur_s"] = dur
+            if fields:
+                rec["fields"] = fields
+            out.append(rec)
+        return out
+
+    def clear(self) -> None:
+        self._events.clear()
+        self._recorded = 0
+
+    def dump(
+        self,
+        path: Optional[str] = None,
+        reason: str = "manual",
+        include_metrics: bool = True,
+    ) -> Optional[str]:
+        """Write the ring (plus a current metrics snapshot — a postmortem
+        wants the counter state too) to `path` or the FSDKR_FLIGHT
+        destination; returns the written path or None when no
+        destination is configured. include_metrics=False skips the
+        registry snapshot — the events-only fallback for contexts where
+        metric locks may be unavailable (see _dump_on_signal)."""
+        path = path or _env_path()
+        if not path:
+            return None
+        metrics = None
+        if include_metrics:
+            try:
+                from .registry import get_registry
+
+                metrics = get_registry().snapshot()
+            except Exception:
+                metrics = None
+        doc = {
+            "schema": FLIGHT_SCHEMA,
+            "pid": os.getpid(),
+            "reason": reason,
+            "started_at": round(self._t0, 3),
+            "dumped_at": round(time.time(), 3),
+            "events_recorded": self._recorded,
+            "events": self.snapshot(),
+            "metrics": metrics,
+        }
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=None, separators=(",", ":"))
+        os.replace(tmp, path)
+        return path
+
+
+def _env_path() -> Optional[str]:
+    v = os.environ.get("FSDKR_FLIGHT", "")
+    if v.lower() in ("", "0", "off", "false", "no"):
+        return None
+    if v.lower() in ("1", "true", "on", "yes"):
+        return f"fsdkr_flight_{os.getpid()}.json"
+    return v
+
+
+_RECORDER = FlightRecorder()
+
+
+def get_flight() -> FlightRecorder:
+    return _RECORDER
+
+
+def record(kind: str, name: str, dur: Optional[float] = None, **fields) -> None:
+    _RECORDER.record(kind, name, dur=dur, **fields)
+
+
+def dump(path: Optional[str] = None, reason: str = "manual") -> Optional[str]:
+    return _RECORDER.dump(path, reason=reason)
+
+
+def _dump_on_signal(reason: str, timeout: float = 2.0) -> None:
+    """Dump from a signal handler without risking a deadlock. The
+    handler interrupts the main thread between bytecodes — possibly
+    INSIDE a registry critical section (metric locks are plain
+    non-reentrant Locks, and function gauges call into subsystems with
+    their own locks), so a direct dump() could block forever on a lock
+    the interrupted frame itself holds. Run the full dump on a watchdog
+    thread; if it cannot finish within `timeout`, write an events-only
+    dump instead — the ring is a plain deque and needs no locks."""
+
+    def work():
+        try:
+            _RECORDER.dump(reason=reason)
+        except Exception:
+            pass
+
+    t = threading.Thread(target=work, daemon=True, name="fsdkr-flight-dump")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        _RECORDER.dump(reason=f"{reason}:events-only", include_metrics=False)
+
+
+_INSTALL_LOCK = threading.Lock()
+_INSTALLED = False
+
+
+_WIDE_DEC = re.compile(r"\d{16,}")
+_WIDE_HEX = re.compile(r"(?:0x)?[0-9a-fA-F]{32,}")
+
+
+def _scrub_detail(msg: str) -> str:
+    """Exception messages are free text and can interpolate operand
+    material (a library ValueError embedding its argument); wide
+    decimal/hex runs ARE operand material in this codebase, so redact
+    them before the message reaches a persisted postmortem — same
+    threshold philosophy as the int allowlist (2^63 ~ 19 digits)."""
+    msg = _WIDE_DEC.sub("<wide-int>", msg)
+    msg = _WIDE_HEX.sub("<wide-hex>", msg)
+    return msg[:120]
+
+
+def handle_exception(exc_type, exc, tb) -> None:
+    """The excepthook body, callable directly (tests simulate a crash by
+    invoking it): dump with the exception recorded as the final event,
+    then defer to the interpreter's default traceback printer."""
+    try:
+        _RECORDER.record(
+            "crash", exc_type.__name__, detail=_scrub_detail(str(exc))
+        )
+        _RECORDER.dump(reason=f"unhandled:{exc_type.__name__}")
+    except Exception:
+        pass
+
+
+def install(force: bool = False) -> bool:
+    """Chain the excepthook and SIGTERM handler (idempotent). No-op
+    unless FSDKR_FLIGHT configures a destination (or force=True)."""
+    global _INSTALLED
+    with _INSTALL_LOCK:
+        if _INSTALLED:
+            return True
+        if not force and _env_path() is None:
+            return False
+
+        prev_hook = sys.excepthook
+
+        def hook(exc_type, exc, tb):
+            handle_exception(exc_type, exc, tb)
+            prev_hook(exc_type, exc, tb)
+
+        sys.excepthook = hook
+
+        try:
+            prev_sig = signal.getsignal(signal.SIGTERM)
+
+            def on_term(signum, frame):
+                try:
+                    _RECORDER.record("signal", "SIGTERM")
+                    _dump_on_signal(reason="SIGTERM")
+                except Exception:
+                    pass
+                if callable(prev_sig):
+                    prev_sig(signum, frame)
+                elif prev_sig is signal.SIG_IGN:
+                    # the process had SIGTERM ignored (possibly
+                    # inherited across exec) — dump but stay alive
+                    return
+                else:
+                    # restore the default disposition and re-raise so the
+                    # process still dies with the standard SIGTERM status
+                    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+            signal.signal(signal.SIGTERM, on_term)
+        except ValueError:
+            pass  # not the main thread: excepthook coverage only
+        _INSTALLED = True
+        return True

@@ -134,3 +134,19 @@ def clear_caches() -> None:
     """Drop every cached entry (cold-cache A/B runs; tests)."""
     if _GLOBAL is not None:
         _GLOBAL.clear()
+
+
+def _register_gauges() -> None:
+    """Expose the persistent precompute cache's counters as telemetry
+    function gauges (read lazily at snapshot time) — the `powm_cache`
+    block of the bench JSON reads the same numbers."""
+    from ..telemetry import registry
+
+    for field in ("entries", "bytes", "hits", "misses", "evictions"):
+        registry.gauge(
+            f"fsdkr_powm_cache_{field}",
+            f"persistent precompute cache lifetime {field} (utils.lru)",
+        ).set_function(lambda f=field: cache_stats()[f])
+
+
+_register_gauges()

@@ -52,10 +52,14 @@ def pipelined(run: Callable, args_list: Sequence[tuple], depth: int = _DEPTH) ->
     from .trace import get_tracer
 
     tracer = get_tracer()
-    phase_name = tracer.current_phase()
+    # capture the submitting thread's SPAN (not just the name): child
+    # spans opened on the workers then parent to it across the thread
+    # hop, so the Chrome-trace timeline shows tile launches nested under
+    # the phase that issued them
+    parent = tracer.current_span() or tracer.current_phase()
 
     def worker(*args):
-        with tracer.inherit_phase(phase_name):
+        with tracer.inherit_phase(parent):
             return run(*args)
 
     out: List = [None] * n
@@ -86,10 +90,10 @@ def submit_bg(fn: Callable) -> Optional["object"]:
     from .trace import get_tracer
 
     tracer = get_tracer()
-    phase_name = tracer.current_phase()
+    parent = tracer.current_span() or tracer.current_phase()
 
     def worker():
-        with tracer.inherit_phase(phase_name):
+        with tracer.inherit_phase(parent):
             return fn()
 
     ex = ThreadPoolExecutor(max_workers=1)
@@ -123,17 +127,42 @@ class BackgroundProducer:
         self._thread: Optional[threading.Thread] = None
         self._thread_stop: Optional[threading.Event] = None
         self.errors = 0
+        # occupancy accounting (telemetry): productive seconds vs wall
+        # since the first start — the producer/consumer balance gauge the
+        # SZKP-style pipelining literature tunes against. Single-writer
+        # (the producer thread), torn reads only perturb a gauge.
+        self.busy_seconds = 0.0
+        self.steps = 0
+        self.started_at: Optional[float] = None
 
     def _loop(self, stop: threading.Event) -> None:
+        import time
+
+        if self.started_at is None:
+            self.started_at = time.monotonic()
         while not stop.is_set():
+            t0 = time.monotonic()
             try:
                 worked = self._step()
             except Exception:
                 self.errors += 1
                 worked = False
-            if not worked:
+            if worked:
+                self.busy_seconds += time.monotonic() - t0
+                self.steps += 1
+            else:
                 self._wake.wait(timeout=60.0)
                 self._wake.clear()
+
+    def occupancy(self) -> float:
+        """Fraction of wall time (since first start) spent producing —
+        0.0 before the first start."""
+        import time
+
+        if self.started_at is None:
+            return 0.0
+        wall = time.monotonic() - self.started_at
+        return self.busy_seconds / wall if wall > 0 else 0.0
 
     def kick(self) -> None:
         """Start the thread if needed and wake it (idempotent, cheap)."""

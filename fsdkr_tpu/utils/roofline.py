@@ -32,6 +32,9 @@ __all__ = [
     "generic_modexp_macs",
     "shared_modexp_macs",
     "modmul_macs",
+    "k16",
+    "stamp_generic_host",
+    "stamp_shared_host",
 ]
 
 # v5e bf16 peak, in MACs/s (197 TFLOP/s / 2 FLOPs-per-MAC). Override for
@@ -76,3 +79,45 @@ def shared_modexp_macs(
 def modmul_macs(rows: int, k: int) -> float:
     """One MontMul per row plus domain enter/exit (~3 total)."""
     return rows * 3 * montmul_macs(k)
+
+
+# ---------------------------------------------------------------------------
+# Host-engine stamping (ISSUE 6 satellite). The device launch layer has
+# stamped its analytic MACs since round 2, but the prover / CRT /
+# precompute phases run through the HOST engines (GMP, native Montgomery,
+# fixed-base combs) and stamped nothing — so their per-phase mfu() read
+# 0 and the roofline only described the verify phases. These helpers are
+# the host engines' one-line stamp: same u16-MAC pricing (a host limb
+# multiply is work-equivalent for the ANALYTIC count; measured MFU stays
+# the profiler's job), attributed to the innermost active phase.
+
+def k16(mod_bits: int) -> int:
+    """Width in 16-bit limbs — the unit every formula above prices."""
+    return max(1, (int(mod_bits) + 15) // 16)
+
+
+def stamp_generic_host(rows: int, exp_bits: int, mod_bits: int) -> None:
+    """Stamp a host generic-modexp batch (GMP / native Montgomery /
+    CRT legs): rows x (exp_bits squarings + exp_bits/4 muls)."""
+    from .trace import get_tracer
+
+    tr = get_tracer()
+    if not tr.enabled or rows <= 0 or exp_bits <= 0:
+        return
+    tr.add_macs(generic_modexp_macs(rows, exp_bits, k16(mod_bits)))
+
+
+def stamp_shared_host(
+    groups: int, rows_per_group: int, exp_bits: int, mod_bits: int
+) -> None:
+    """Stamp a host fixed-base comb batch (native modexp_shared / the
+    prover's persistent Lim-Lee combs)."""
+    from .trace import get_tracer
+
+    tr = get_tracer()
+    if not tr.enabled or rows_per_group <= 0 or exp_bits <= 0:
+        return
+    windows = max(1, exp_bits // 4)
+    tr.add_macs(
+        shared_modexp_macs(groups, rows_per_group, windows, k16(mod_bits))
+    )

@@ -1,191 +1,22 @@
-"""Tracing / metrics for the protocol hot paths.
+"""Back-compat shim: the tracer moved to `fsdkr_tpu.telemetry.spans`.
 
-The reference has no tracing at all (SURVEY.md §5: the only hook is a
-disabled benchmark flag in its test simulator, `src/test.rs:229,341`);
-errors are its only diagnostics. The rebuild adds the subsystem the
-batched design needs: per-phase wall-clock timers and item counters
-around every verify family and prover column, plus an optional XLA
-profiler trace for kernel-level inspection.
-
-Usage:
-    from fsdkr_tpu.utils import get_tracer, phase
-
-    with phase("verify_pdl", items=len(items)):
-        ...
-    print(get_tracer().report())
-
-Timers are process-global and thread-safe; `FSDKR_TRACE=1` (or
-`get_tracer().enable()`) turns collection on, and the protocol layer
-stamps its phases unconditionally — a disabled tracer costs two
-`time.perf_counter` calls per phase.
+Every historical import site (`from fsdkr_tpu.utils.trace import phase`,
+`from fsdkr_tpu.utils import get_tracer`, ...) keeps working unchanged;
+the process-global tracer is the SAME object either way. New code should
+import from `fsdkr_tpu.telemetry` directly, which also exposes the
+metrics registry, exporters, and the flight recorder the old flat
+aggregator never had.
 """
 
 from __future__ import annotations
 
-import contextlib
-import os
-import threading
-import time
-from dataclasses import dataclass, field
-from typing import Dict, Iterator, Optional
+from ..telemetry.spans import (  # noqa: F401
+    PhaseStats,
+    Span,
+    Tracer,
+    get_tracer,
+    jax_profile,
+    phase,
+)
 
-__all__ = ["PhaseStats", "Tracer", "get_tracer", "phase", "jax_profile"]
-
-
-@dataclass
-class PhaseStats:
-    calls: int = 0
-    seconds: float = 0.0
-    items: int = 0
-    macs: float = 0.0  # analytic u16-MAC count (utils.roofline)
-
-    @property
-    def items_per_second(self) -> float:
-        return self.items / self.seconds if self.seconds > 0 else 0.0
-
-    def mfu(self, peak: float) -> float:
-        return self.macs / self.seconds / peak if self.seconds > 0 else 0.0
-
-
-@dataclass
-class Tracer:
-    enabled: bool = field(
-        default_factory=lambda: os.environ.get("FSDKR_TRACE", "0") not in ("", "0")
-    )
-    _stats: Dict[str, PhaseStats] = field(default_factory=dict)
-    _lock: threading.Lock = field(default_factory=threading.Lock)
-    _local: threading.local = field(default_factory=threading.local)
-
-    def enable(self) -> None:
-        self.enabled = True
-
-    def disable(self) -> None:
-        self.enabled = False
-
-    def reset(self) -> None:
-        with self._lock:
-            self._stats.clear()
-
-    @contextlib.contextmanager
-    def phase(self, name: str, items: int = 0) -> Iterator[None]:
-        if not self.enabled:
-            yield
-            return
-        stack = self._phase_stack()
-        stack.append(name)
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            stack.pop()
-            with self._lock:
-                st = self._stats.setdefault(name, PhaseStats())
-                st.calls += 1
-                st.seconds += dt
-                st.items += items
-
-    def _phase_stack(self) -> list:
-        stack = getattr(self._local, "stack", None)
-        if stack is None:
-            stack = self._local.stack = []
-        return stack
-
-    def current_phase(self) -> Optional[str]:
-        """Innermost active phase of THIS thread (None outside any)."""
-        stack = getattr(self._local, "stack", None)
-        return stack[-1] if stack else None
-
-    @contextlib.contextmanager
-    def inherit_phase(self, name: Optional[str]) -> Iterator[None]:
-        """Attribute work on a worker thread to the submitting thread's
-        phase: pushes `name` onto this thread's phase stack WITHOUT
-        timing it (the submitter's enclosing `phase` already owns the
-        wall clock; a timed re-entry would double-count seconds). Used
-        by utils.pipeline so add_macs from pipelined tiles lands in the
-        right phase instead of \"(unphased)\"."""
-        if not self.enabled or name is None:
-            yield
-            return
-        stack = self._phase_stack()
-        stack.append(name)
-        try:
-            yield
-        finally:
-            stack.pop()
-
-    def add_macs(self, macs: float) -> None:
-        """Attribute analytic device work (utils.roofline formulas) to the
-        innermost active phase of this thread — the kernel launch layer
-        calls this without knowing which protocol phase it serves."""
-        if not self.enabled:
-            return
-        stack = self._phase_stack()
-        name = stack[-1] if stack else "(unphased)"
-        with self._lock:
-            self._stats.setdefault(name, PhaseStats()).macs += macs
-
-    def count(self, name: str, items: int = 1) -> None:
-        if not self.enabled:
-            return
-        with self._lock:
-            st = self._stats.setdefault(name, PhaseStats())
-            st.calls += 1
-            st.items += items
-
-    def stats(self) -> Dict[str, PhaseStats]:
-        with self._lock:
-            return {
-                k: PhaseStats(v.calls, v.seconds, v.items, v.macs)
-                for k, v in self._stats.items()
-            }
-
-    def report(self) -> str:
-        from .roofline import peak_macs
-
-        peak = peak_macs()
-        rows = sorted(self.stats().items(), key=lambda kv: -kv[1].seconds)
-        if not rows:
-            return "(no phases recorded)"
-        width = max(len(k) for k, _ in rows)
-        lines = [
-            f"{'phase':{width}s} {'calls':>6s} {'seconds':>9s} {'items':>8s} "
-            f"{'items/s':>10s} {'GMACs':>9s} {'mfu%':>7s}"
-        ]
-        for name, st in rows:
-            lines.append(
-                f"{name:{width}s} {st.calls:6d} {st.seconds:9.3f} "
-                f"{st.items:8d} {st.items_per_second:10.1f} "
-                f"{st.macs / 1e9:9.2f} {100 * st.mfu(peak):7.3f}"
-            )
-        return "\n".join(lines)
-
-
-_TRACER = Tracer()
-
-
-def get_tracer() -> Tracer:
-    return _TRACER
-
-
-def phase(name: str, items: int = 0):
-    """Module-level shorthand for `get_tracer().phase(...)`."""
-    return _TRACER.phase(name, items=items)
-
-
-@contextlib.contextmanager
-def jax_profile(log_dir: Optional[str] = None) -> Iterator[None]:
-    """XLA profiler trace around a block (view with xprof/tensorboard).
-    No-op when jax is unavailable or log_dir is None and FSDKR_XPROF is
-    unset."""
-    log_dir = log_dir or os.environ.get("FSDKR_XPROF")
-    if not log_dir:
-        yield
-        return
-    try:
-        import jax
-    except ImportError:
-        yield
-        return
-    with jax.profiler.trace(log_dir):
-        yield
+__all__ = ["PhaseStats", "Span", "Tracer", "get_tracer", "phase", "jax_profile"]

@@ -57,6 +57,43 @@ FSDKR_CRT=0 FSDKR_GMP=0 python -m pytest tests/test_crt.py \
   tests/test_proofs.py tests/test_native.py tests/test_thread_parity.py \
   -q -m "not slow and not heavy" -p no:cacheprovider
 
+echo "== test: telemetry export leg (FSDKR_TRACE=1 + dumps) =="
+# the smoke tier above ran untraced; this leg turns on span tracing AND
+# both export paths (Chrome trace, Prometheus dump, flight recorder) on
+# the telemetry-facing suites, then drives one tiny traced refresh and
+# asserts the three artifacts actually materialize — so the export
+# paths cannot rot (same pattern as the A/B legs above)
+rm -f /tmp/fsdkr_ci_trace.json /tmp/fsdkr_ci_metrics.prom /tmp/fsdkr_ci_flight.json
+FSDKR_TRACE=1 python -m pytest tests/test_telemetry.py tests/test_trace.py \
+  -q -m "not slow and not heavy" -p no:cacheprovider
+FSDKR_TRACE=1 FSDKR_TRACE_OUT=/tmp/fsdkr_ci_trace.json \
+  FSDKR_METRICS_DUMP=/tmp/fsdkr_ci_metrics.prom \
+  FSDKR_FLIGHT=/tmp/fsdkr_ci_flight.json \
+  python - <<'EOF'
+import json, os
+from fsdkr_tpu.config import TEST_CONFIG
+from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+from fsdkr_tpu import telemetry
+
+keys = simulate_keygen(1, 3, TEST_CONFIG)
+results = RefreshMessage.distribute_batch([(k.i, k) for k in keys], 3, TEST_CONFIG)
+RefreshMessage.collect([m for m, _ in results], keys[0].clone(),
+                       results[0][1], (), TEST_CONFIG)
+telemetry.get_tracer().write_chrome_trace(os.environ["FSDKR_TRACE_OUT"])
+telemetry.export.dump_metrics(os.environ["FSDKR_METRICS_DUMP"])
+telemetry.flight.dump(reason="ci")
+trace = json.load(open(os.environ["FSDKR_TRACE_OUT"]))
+spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+assert any(e["name"] == "collect" for e in spans), "no collect span"
+assert any(e["name"].startswith("distribute") for e in spans)
+assert any("parent_id" in e["args"] for e in spans), "no nesting"
+prom = open(os.environ["FSDKR_METRICS_DUMP"]).read()
+assert "fsdkr_phase_seconds_bucket" in prom
+flight = json.load(open(os.environ["FSDKR_FLIGHT"]))
+assert flight["events"], "flight ring empty"
+print("telemetry export leg ok:", len(spans), "spans")
+EOF
+
 echo "== test: FSDKR_PRECOMPUTE=0 leg (inline prover path) =="
 # the smoke tier above ran with the default FSDKR_PRECOMPUTE=1 (pool
 # consume-or-compute in distribute); this leg forces the inline path on

@@ -89,6 +89,54 @@ def main():
             )
         print()
 
+    # unified telemetry blocks (ISSUE 6): newer bench JSONs embed the
+    # schema-versioned registry snapshot under "telemetry" — phase
+    # latency percentiles and the pool/producer gauges. Old
+    # BENCH_r0*.json files without the block still digest through the
+    # legacy rlc/crt/precompute keys handled above.
+    for name, rec in configs:
+        tel = rec.get("telemetry")
+        if not tel or "metrics" not in tel:
+            continue
+        metrics = tel["metrics"]
+        print(
+            f"### telemetry: {name} "
+            f"(schema {tel.get('schema', '?')})\n"
+        )
+        hist = metrics.get("fsdkr_phase_seconds")
+        if hist and hist.get("values"):
+            print("| phase | calls | total s | p50 | p95 | p99 |")
+            print("|---|---|---|---|---|---|")
+            rows = sorted(
+                hist["values"], key=lambda v: -v.get("sum", 0)
+            )[:15]
+            for v in rows:
+                print(
+                    f"| {v['labels'].get('phase', '?')} | {v['count']} "
+                    f"| {round(v['sum'], 3)} | {v['p50']} | {v['p95']} "
+                    f"| {v['p99']} |"
+                )
+            print()
+        gauge_rows = []
+        for gname in (
+            "fsdkr_pool_depth", "fsdkr_pool_bytes", "fsdkr_pool_count",
+            "fsdkr_producer_occupancy", "fsdkr_producer_steps",
+        ):
+            for v in metrics.get(gname, {}).get("values", []):
+                labels = ",".join(
+                    f"{k}={x}" for k, x in v["labels"].items()
+                )
+                gauge_rows.append(
+                    (gname + (f"{{{labels}}}" if labels else ""),
+                     v["value"])
+                )
+        if gauge_rows:
+            print("| gauge | value |")
+            print("|---|---|")
+            for g, v in gauge_rows:
+                print(f"| {g} | {v} |")
+            print()
+
     if kernels:
         print("### kernel sweep (modexp rows/s, real chip)\n")
         print("| kernel | bits | exp bits | rows | groups | seconds | modexp/s |")

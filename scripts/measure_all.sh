@@ -175,6 +175,16 @@ run n16_nocrt 2400 FSDKR_CRT=0 FSDKR_TRACE=1 python bench.py
 # mirroring the n16_nocrt pattern). The CPU-platform acceptance pair is
 # bench_results/precompute_ab_n16_{on,off}.json.
 run n16_noprecompute 2400 FSDKR_PRECOMPUTE=0 FSDKR_TRACE=1 python bench.py
+# telemetry trace-overhead A/B (ISSUE 6): one traced bench run that adds
+# an extra warm collect with the tracer forced OFF in the same process —
+# the JSON carries collect_warm_s (traced), collect_warm_notrace_s
+# (disabled path), and trace_overhead_pct. The disabled arm is the one
+# under the 2%-of-baseline budget; the CPU-platform acceptance pair is
+# bench_results/trace_ab_n16.json. Trace/metrics artifacts land next to
+# the JSON so a timeline of this exact run is always on disk.
+run n16_trace_overhead 2400 FSDKR_TRACE=1 BENCH_TRACE_AB=1 \
+  FSDKR_TRACE_OUT="$R/n16_trace_overhead.trace.json" \
+  FSDKR_METRICS_DUMP="$R/n16_trace_overhead.prom" python bench.py
 
 # host-engine thread scaling (FSDKR_THREADS row pool; 1 = the historical
 # serial loop, auto = all cores). Pinned to the CPU platform + host

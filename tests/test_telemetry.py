@@ -1,0 +1,583 @@
+"""Tests for the unified telemetry subsystem (fsdkr_tpu.telemetry):
+hierarchical spans (incl. cross-thread parenting and the background
+producer's own track), the labeled metrics registry with bucket-derived
+percentiles, the schema-versioned snapshot / Prometheus exposition, the
+flight recorder's crash flush, the disabled-path overhead bound, and the
+telemetry secrecy rule (no witness material in any export).
+
+tests/test_trace.py pins the legacy `utils.trace` surface through the
+back-compat shim; this file pins everything the old flat aggregator
+could not do."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fsdkr_tpu.telemetry import export, flight
+from fsdkr_tpu.telemetry.registry import (
+    Histogram,
+    Registry,
+    check_label_value,
+)
+from fsdkr_tpu.telemetry.spans import Tracer
+from fsdkr_tpu.utils.trace import get_tracer
+
+
+# ---------------------------------------------------------------------------
+# spans
+
+
+class TestSpans:
+    def test_nesting_same_thread(self):
+        tr = Tracer(enabled=True)
+        with tr.phase("outer"):
+            with tr.phase("outer.mid"):
+                with tr.phase("outer.mid.leaf"):
+                    pass
+        spans = {s.name: s for s in tr.spans()}
+        assert spans["outer"].parent_id is None
+        assert spans["outer.mid"].parent_id == spans["outer"].span_id
+        assert spans["outer.mid.leaf"].parent_id == spans["outer.mid"].span_id
+        # child intervals sit inside the parent's
+        assert spans["outer"].t0 <= spans["outer.mid"].t0
+        assert spans["outer.mid"].t1 <= spans["outer"].t1
+
+    def test_nesting_across_pipeline_threads(self, monkeypatch):
+        """Worker threads primed by utils.pipeline parent their spans to
+        the submitting thread's phase — the tile-dispatch shape."""
+        monkeypatch.setenv("FSDKR_PIPELINE", "1")
+        from fsdkr_tpu.utils.pipeline import pipelined
+
+        tr = get_tracer()
+        tr.reset()
+        tr.enable()
+        try:
+            def tile(i):
+                with tr.phase("launch.tile", items=1):
+                    return i * i
+
+            with tr.phase("launch"):
+                out = pipelined(tile, [(i,) for i in range(4)])
+        finally:
+            tr.disable()
+        assert out == [0, 1, 4, 9]
+        spans = tr.spans()
+        launch = [s for s in spans if s.name == "launch"][0]
+        tiles = [s for s in spans if s.name == "launch.tile"]
+        assert len(tiles) == 4
+        assert all(t.parent_id == launch.span_id for t in tiles)
+        # at least one tile really ran off-thread (depth-2 pool, 4 tiles)
+        assert any(t.tid != launch.tid for t in tiles)
+
+    def test_producer_thread_spans_parented(self, monkeypatch):
+        """The background producer's work shows up as its own thread
+        track: step spans rooted on the producer thread (no cross-thread
+        parent leakage), with the per-kind produce span nested under the
+        step span."""
+        monkeypatch.setenv("FSDKR_PRECOMPUTE", "1")
+        monkeypatch.setenv("FSDKR_PRECOMPUTE_BG", "1")
+        from fsdkr_tpu import precompute
+
+        tr = get_tracer()
+        tr.reset()
+        tr.enable()
+        precompute.clear_targets()
+        precompute.clear_pools()
+        n_mod = (2**61 - 1) * (2**62 + 135)  # any odd public modulus
+        try:
+            precompute.register_targets([("enc", n_mod, 4)])
+            precompute.kick()
+            store = precompute.get_store()
+            deadline = time.time() + 30
+            while store.depth("enc", n_mod) < 4 and time.time() < deadline:
+                time.sleep(0.02)
+            assert store.depth("enc", n_mod) == 4, "producer never filled"
+        finally:
+            precompute.stop_background()
+            precompute.clear_targets()
+            precompute.clear_pools()
+            tr.disable()
+        spans = tr.spans()
+        steps = [s for s in spans if s.name == "precompute.producer.step"]
+        produces = [s for s in spans if s.name == "precompute.produce.enc"]
+        assert steps and produces
+        main_tid = [s for s in spans if s.name not in
+                    ("precompute.producer.step", "precompute.produce.enc")]
+        step_ids = {s.span_id for s in steps}
+        for s in steps:
+            assert s.thread_name == "fsdkr-precompute"
+            assert s.parent_id is None  # its own root, not a leaked parent
+        for p in produces:
+            assert p.thread_name == "fsdkr-precompute"
+            assert p.parent_id in step_ids
+        del main_tid
+
+    def test_attr_allowlist_drops_wide_ints(self):
+        tr = Tracer(enabled=True)
+        secret = 1 << 2048
+        with tr.phase("p", kind="enc", rows=4, modulus=secret):
+            pass
+        (span,) = tr.spans()
+        assert span.attrs == {"kind": "enc", "rows": 4}
+        assert tr.attrs_dropped() == 1
+        assert tr.spans_dropped() == 0  # the SPAN itself was kept
+
+    def test_span_cap_bounds_memory(self):
+        tr = Tracer(enabled=True, max_spans=8)
+        for _ in range(20):
+            with tr.phase("p"):
+                pass
+        assert len(tr.spans()) == 8
+        assert tr.spans_dropped() == 12
+        # aggregates keep counting past the cap
+        assert tr.stats()["p"].calls == 20
+
+    def test_disabled_tracer_overhead_bound(self):
+        """The disabled path (two perf_counter calls + one histogram
+        observe + one ring append) must stay micro-cheap: 20k phases in
+        well under 2 s even on a loaded box (~100 us/phase budget; the
+        real cost is ~2-4 us)."""
+        tr = Tracer(enabled=False)
+        t0 = time.perf_counter()
+        for _ in range(20000):
+            with tr.phase("hot", items=1):
+                pass
+        dt = time.perf_counter() - t0
+        assert tr.stats() == {}
+        assert not tr.spans()
+        assert dt < 2.0, f"disabled-phase overhead {dt / 20000 * 1e6:.1f} us"
+
+
+class TestChromeTrace:
+    def test_chrome_trace_json_validity(self, tmp_path):
+        tr = Tracer(enabled=True)
+        with tr.phase("collect", items=2):
+            with tr.phase("collect.verify", items=2):
+                pass
+        path = tr.write_chrome_trace(str(tmp_path / "trace.json"))
+        doc = json.load(open(path))
+        evs = doc["traceEvents"]
+        xs = [e for e in evs if e["ph"] == "X"]
+        assert len(xs) == 2
+        for e in xs:
+            assert e["ts"] >= 0 and e["dur"] >= 0
+            assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+            assert "span_id" in e["args"]
+        parent = [e for e in xs if e["name"] == "collect"][0]
+        child = [e for e in xs if e["name"] == "collect.verify"][0]
+        assert child["args"]["parent_id"] == parent["args"]["span_id"]
+        assert child["ts"] >= parent["ts"]
+        assert child["ts"] + child["dur"] <= parent["ts"] + parent["dur"] + 1
+        # thread metadata present so Perfetto labels the tracks
+        assert any(e["ph"] == "M" and e["name"] == "thread_name" for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# registry
+
+
+class TestRegistry:
+    def test_histogram_percentiles_vs_oracle(self):
+        buckets = tuple(i / 100 for i in range(1, 201))  # 10ms-wide .. 2.0
+        h = Histogram("t_hist", "", (), buckets=buckets)
+        values = [0.015 * (i % 97) + 0.003 for i in range(3000)]
+        child = h._child(())
+        for v in values:
+            child.observe(v)
+        ordered = sorted(values)
+        for q in (0.50, 0.95, 0.99):
+            oracle = ordered[min(len(ordered) - 1, int(q * len(ordered)))]
+            got = child.percentile(q)
+            # resolution bound: one bucket width (0.01) + half the value
+            # spacing (0.015/2) — bucket-derived percentiles are honest
+            # to the ladder, not to the sample
+            assert abs(got - oracle) <= 0.0185, (q, got, oracle)
+        snap = child.snapshot()
+        assert snap["count"] == 3000
+        assert abs(snap["sum"] - sum(values)) < 1e-6
+        assert snap["p50"] < snap["p95"] < snap["p99"]
+
+    def test_histogram_overflow_clamps(self):
+        h = Histogram("t_hist2", "", (), buckets=(0.1, 1.0))
+        c = h._child(())
+        for _ in range(10):
+            c.observe(50.0)  # beyond the last bound
+        assert c.percentile(0.99) == 1.0  # clamped, honest resolution
+
+    def test_counter_and_gauge(self):
+        r = Registry()
+        c = r.counter("t_events", "ev", labelnames=("event",))
+        c.inc(3, event="a")
+        c.inc(event="b")
+        assert c.value(event="a") == 3 and c.total() == 4
+        with pytest.raises(ValueError):
+            c.inc(-1, event="a")
+        g = r.gauge("t_depth", "d", labelnames=("kind",))
+        g.set(7, kind="enc")
+        g.dec(2, kind="enc")
+        assert g.labels(kind="enc").value == 5
+
+    def test_snapshot_schema(self):
+        r = Registry()
+        r.counter("t_c", "help c", ("k",)).inc(2, k="x")
+        r.gauge("t_g", "help g").set(1.5)
+        r.histogram("t_h", "help h", buckets=(1.0, 2.0)).observe(1.5)
+        snap = r.snapshot()
+        assert snap["schema"].startswith("fsdkr-telemetry/")
+        m = snap["metrics"]
+        assert m["t_c"]["type"] == "counter"
+        assert m["t_c"]["values"] == [{"labels": {"k": "x"}, "value": 2.0}]
+        assert m["t_g"]["values"][0]["value"] == 1.5
+        h = m["t_h"]["values"][0]
+        assert h["count"] == 1 and "p99" in h and h["buckets"][0] == [1.0, 0]
+        assert json.loads(json.dumps(snap)) == snap  # JSON-clean
+
+    def test_function_gauges(self):
+        r = Registry()
+        r.gauge("t_fn", "lazy").set_function(lambda: 42)
+        r.gauge("t_fn_lab", "lazy", ("kind",)).set_labeled_function(
+            lambda: {("enc",): 3, ("keys",): 1}
+        )
+        r.gauge("t_fn_broken", "raises").set_function(
+            lambda: (_ for _ in ()).throw(RuntimeError())
+        )
+        m = r.snapshot()["metrics"]
+        assert m["t_fn"]["values"][0]["value"] == 42
+        vals = {
+            v["labels"]["kind"]: v["value"] for v in m["t_fn_lab"]["values"]
+        }
+        assert vals == {"enc": 3.0, "keys": 1.0}
+        assert m["t_fn_broken"]["values"] == []  # no sample, no crash
+
+    def test_type_conflict_raises(self):
+        r = Registry()
+        r.counter("t_once", "")
+        with pytest.raises(ValueError):
+            r.gauge("t_once", "")
+        with pytest.raises(ValueError):
+            r.counter("t_once", "", labelnames=("x",))
+
+    def test_bucket_conflict_raises(self):
+        r = Registry()
+        h = r.histogram("t_hb", "", buckets=(0.001, 0.01, 0.1))
+        assert r.histogram("t_hb", "") is h  # None buckets: get existing
+        assert r.histogram("t_hb", "", buckets=(0.1, 0.01, 0.001)) is h
+        with pytest.raises(ValueError):
+            r.histogram("t_hb", "", buckets=(0.5, 1.0))
+
+    def test_label_allowlist_rejects_operands(self):
+        with pytest.raises(ValueError):
+            check_label_value(1 << 64)
+        with pytest.raises(ValueError):
+            check_label_value([1, 2])
+        with pytest.raises(ValueError):
+            check_label_value("x" * 500)
+        assert check_label_value(True) == "true"
+        assert check_label_value(12) == "12"
+        r = Registry()
+        c = r.counter("t_sec", "", ("modulus",))
+        with pytest.raises(ValueError):
+            c.inc(modulus=(2**127 - 1) * (2**89 - 1))
+
+    def test_reset_window(self):
+        r = Registry()
+        c = r.counter("t_w", "", ("e",))
+        c.inc(5, e="a")
+        g = r.gauge("t_wg", "")
+        g.set(3)
+        r.reset_window()
+        assert c.total() == 0
+        assert g.labels().value == 3  # gauges keep point-in-time state
+
+
+class TestPortedStatBlocks:
+    """The five legacy stat surfaces stay API-identical but read from
+    the registry now — one snapshot carries all of them."""
+
+    def test_rlc_stats_ride_registry(self):
+        from fsdkr_tpu.backend import rlc
+
+        rlc.stats_reset()
+        rlc.count("rlc_groups", 2)
+        rlc.count("rows_folded", 64)
+        assert rlc.stats()["rlc_groups"] == 2
+        snap = export.snapshot()["metrics"]["fsdkr_rlc_events"]
+        vals = {v["labels"]["event"]: v["value"] for v in snap["values"]}
+        assert vals["rlc_groups"] == 2 and vals["rows_folded"] == 64
+        rlc.stats_reset()
+        assert rlc.stats()["rlc_groups"] == 0
+
+    def test_precompute_stats_ride_registry(self, monkeypatch):
+        monkeypatch.setenv("FSDKR_PRECOMPUTE", "1")
+        from fsdkr_tpu import precompute
+
+        precompute.clear_pools()
+        precompute.stats_reset()
+        precompute.put("enc", 15, (3, 9))
+        assert precompute.precompute_stats()["produced"] == 1
+        snap = export.snapshot()["metrics"]
+        depth = {
+            v["labels"]["kind"]: v["value"]
+            for v in snap["fsdkr_pool_depth"]["values"]
+        }
+        assert depth.get("enc") == 1
+        assert precompute.take("enc", 15) == (3, 9)
+        assert precompute.take("enc", 15) is None  # dry
+        st = precompute.precompute_stats()
+        assert st["consumed"] == 1 and st["dry_fallbacks"] == 1
+        precompute.clear_pools()
+        precompute.stats_reset()
+
+    def test_gen_stats_and_crt_stats_ride_registry(self):
+        from fsdkr_tpu.backend import crt
+        from fsdkr_tpu.core import primes
+
+        primes.gen_stats_reset()
+        primes.gen_primes_batch(64, 1)
+        gs = primes.gen_stats()
+        assert gs["candidates"] > 0 and gs["mr_rounds"] > 0
+        snap = export.snapshot()["metrics"]
+        vals = {
+            v["labels"]["event"]: v["value"]
+            for v in snap["fsdkr_primegen_events"]["values"]
+        }
+        assert vals["candidates"] == gs["candidates"]
+        primes.gen_stats_reset()
+        crt.stats_reset()
+        assert set(crt.crt_stats()) == {
+            "rows", "legs", "fault_checks", "fallback_rows", "exp_bits_saved"
+        }
+        from fsdkr_tpu.utils import lru as _lru  # registers its gauges
+
+        assert _lru.cache_stats() is not None
+        assert "fsdkr_powm_cache_hits" in export.snapshot()["metrics"]
+
+
+# ---------------------------------------------------------------------------
+# export
+
+
+class TestExport:
+    def test_prometheus_text(self):
+        from fsdkr_tpu.telemetry.registry import get_registry
+
+        get_registry().counter(
+            "t_prom_events", "prom test", ("event",)
+        ).inc(4, event="x")
+        text = export.prometheus_text()
+        assert "# TYPE t_prom_events_total counter" in text
+        assert 't_prom_events_total{event="x"} 4' in text
+        assert "# TYPE fsdkr_phase_seconds histogram" in text
+        assert "fsdkr_phase_seconds_bucket" in text
+        assert 'le="+Inf"' in text
+
+    def test_dump_metrics_roundtrip(self, tmp_path):
+        path = export.dump_metrics(str(tmp_path / "m.prom"))
+        body = open(path).read()
+        assert body.startswith("# fsdkr telemetry schema fsdkr-telemetry/")
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+
+_CRASH_SCRIPT = """
+import os, sys
+sys.path.insert(0, {repo!r})
+from fsdkr_tpu import telemetry
+telemetry.flight.record("work", "step1", dur=0.5, rows=4)
+telemetry.get_tracer().enable()
+with telemetry.phase("doomed.phase"):
+    pass
+raise RuntimeError("simulated tunnel-window crash")
+"""
+
+_SIGTERM_SCRIPT = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from fsdkr_tpu import telemetry
+telemetry.flight.record("work", "before-term")
+print("READY", flush=True)
+time.sleep(30)
+"""
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        rec = flight.FlightRecorder(cap=16)
+        for i in range(100):
+            rec.record("span", f"p{i}", dur=0.001)
+        evs = rec.snapshot()
+        assert len(evs) == 16
+        assert evs[-1]["name"] == "p99"  # last N survive
+
+    def test_fields_allowlisted(self):
+        rec = flight.FlightRecorder(cap=8)
+        rec.record("span", "p", rows=3, modulus=1 << 2048)
+        (ev,) = rec.snapshot()
+        assert ev["fields"] == {"rows": 3}
+
+    def test_crash_flush_subprocess(self, tmp_path):
+        """An unhandled exception in a real interpreter leaves the
+        postmortem artifact (the tunnel-window failure mode)."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = tmp_path / "flight.json"
+        env = {**os.environ, "FSDKR_FLIGHT": str(out)}
+        res = subprocess.run(
+            [sys.executable, "-c", _CRASH_SCRIPT.format(repo=repo)],
+            env=env, capture_output=True, timeout=60,
+        )
+        assert res.returncode != 0  # still died
+        assert b"simulated tunnel-window crash" in res.stderr  # still printed
+        doc = json.load(open(out))
+        assert doc["schema"].startswith("fsdkr-flight/")
+        assert doc["reason"] == "unhandled:RuntimeError"
+        names = [e["name"] for e in doc["events"]]
+        assert "step1" in names and "doomed.phase" in names
+        assert "RuntimeError" in names  # the crash event itself
+        assert doc["metrics"]["schema"].startswith("fsdkr-telemetry/")
+
+    def test_sigterm_flush_subprocess(self, tmp_path):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = tmp_path / "flight_term.json"
+        env = {**os.environ, "FSDKR_FLIGHT": str(out)}
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _SIGTERM_SCRIPT.format(repo=repo)],
+            env=env, stdout=subprocess.PIPE,
+        )
+        try:
+            assert proc.stdout.readline().strip() == b"READY"
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=30)
+        finally:
+            proc.kill()
+        doc = json.load(open(out))
+        assert doc["reason"] == "SIGTERM"
+        assert any(e["name"] == "before-term" for e in doc["events"])
+
+    def test_crash_detail_scrubs_wide_numbers(self, tmp_path, monkeypatch):
+        """Exception messages are free text — wide decimal/hex runs
+        (operand material) must not survive into the postmortem."""
+        p = (2**127 - 1) * (2**89 - 1)
+        scrubbed = flight._scrub_detail(f"bad modulus {p} (0x{p:x}) rows=3")
+        assert str(p) not in scrubbed and f"{p:x}" not in scrubbed
+        assert "<wide-int>" in scrubbed and "<wide-hex>" in scrubbed
+        assert "rows=3" in scrubbed  # small scalars survive
+        out = tmp_path / "scrub.json"
+        monkeypatch.setenv("FSDKR_FLIGHT", str(out))
+        flight.handle_exception(ValueError, ValueError(f"leak {p}"), None)
+        assert str(p) not in out.read_text()
+
+    def test_env_path_off_values_case_insensitive(self, monkeypatch):
+        for v in ("off", "OFF", "No", "False", "0", ""):
+            monkeypatch.setenv("FSDKR_FLIGHT", v)
+            assert flight._env_path() is None, v
+        monkeypatch.setenv("FSDKR_FLIGHT", "On")
+        assert flight._env_path().startswith("fsdkr_flight_")
+        monkeypatch.setenv("FSDKR_FLIGHT", "/tmp/x.json")
+        assert flight._env_path() == "/tmp/x.json"
+
+    def test_signal_dump_survives_held_metric_lock(
+        self, tmp_path, monkeypatch
+    ):
+        """SIGTERM can interrupt the main thread INSIDE a registry
+        critical section; the signal-path dump must not deadlock on the
+        lock the interrupted frame holds — it falls back to an
+        events-only dump (the failure mode is a hung process that
+        neither dumps nor dies)."""
+        from fsdkr_tpu.telemetry.registry import get_registry
+
+        out = tmp_path / "held.json"
+        monkeypatch.setenv("FSDKR_FLIGHT", str(out))
+        flight.record("span", "held-evidence")
+        reg = get_registry()
+        with reg._lock:  # the interrupted frame's held lock
+            flight._dump_on_signal(reason="SIGTERM", timeout=0.3)
+            # read while the lock is still held: the blocked watchdog
+            # thread must not have been able to write a full dump
+            doc = json.load(open(out))
+        assert doc["reason"] == "SIGTERM:events-only"
+        assert doc["metrics"] is None
+        assert any(e["name"] == "held-evidence" for e in doc["events"])
+
+    def test_handle_exception_inprocess(self, tmp_path):
+        """The hook body is directly callable (simulated crash without a
+        subprocess) and dumps to an explicit FSDKR_FLIGHT path."""
+        out = tmp_path / "inproc.json"
+        old = os.environ.get("FSDKR_FLIGHT")
+        os.environ["FSDKR_FLIGHT"] = str(out)
+        try:
+            flight.record("span", "inproc-evidence")
+            flight.handle_exception(ValueError, ValueError("boom"), None)
+        finally:
+            if old is None:
+                os.environ.pop("FSDKR_FLIGHT", None)
+            else:
+                os.environ["FSDKR_FLIGHT"] = old
+        doc = json.load(open(out))
+        assert doc["reason"] == "unhandled:ValueError"
+
+
+# ---------------------------------------------------------------------------
+# telemetry secrecy (satellite): a full traced transcript dump carries no
+# witness material
+
+
+@pytest.mark.fresh_committees
+def test_traced_transcript_dump_has_no_secret_bytes(test_config, tmp_path):
+    """Run a full FSDKR_TRACE=1 n=4 refresh (distribute + collect, pools
+    on), export EVERY telemetry artifact — chrome trace, registry
+    snapshot, Prometheus text, flight dump — and grep the lot for the
+    run's planted secrets (Paillier factors, shares, pool randomizers) in
+    decimal and hex. Fresh committees so the secrets are this test's own,
+    not the cached session committee's."""
+    from fsdkr_tpu import precompute
+    from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+
+    tr = get_tracer()
+    tr.reset()
+    tr.enable()
+    try:
+        keys = simulate_keygen(1, 4, test_config)
+        secrets_planted = []
+        for k in keys:
+            secrets_planted += [
+                k.paillier_dk.p, k.paillier_dk.q, k.keys_linear.x_i.to_int()
+            ]
+        # pool entries are secret too: prefill so spans cover production
+        precompute.prefill(keys[0], 4, 4, test_config)
+        secrets_planted += precompute.get_store().secret_values()
+        results = RefreshMessage.distribute_batch(
+            [(k.i, k) for k in keys], 4, test_config
+        )
+        msgs = [m for m, _ in results]
+        RefreshMessage.collect(msgs, keys[0].clone(), results[0][1], (),
+                               test_config)
+        secrets_planted += [r[1].p for r in results] + [
+            r[1].q for r in results
+        ]
+    finally:
+        tr.disable()
+        precompute.clear_pools()
+        precompute.clear_targets()
+
+    trace_path = tr.write_chrome_trace(str(tmp_path / "t.json"))
+    flight_path = flight.dump(str(tmp_path / "f.json"), reason="test")
+    blob = (
+        open(trace_path).read()
+        + json.dumps(export.snapshot())
+        + export.prometheus_text()
+        + open(flight_path).read()
+    )
+    assert len(tr.spans()) > 10  # the dump really covered the pipeline
+    for s in secrets_planted:
+        s = abs(int(s))
+        if s.bit_length() < 64:
+            continue  # small ints collide with benign counters
+        assert str(s) not in blob, "decimal secret leaked into telemetry"
+        assert format(s, "x") not in blob, "hex secret leaked into telemetry"
