@@ -749,6 +749,7 @@ def main():
     # reported as vs_cpython for comparability with earlier rounds).
     from fsdkr_tpu import native
     from fsdkr_tpu.backend.batch_verifier import HostBatchVerifier
+    from fsdkr_tpu.backend.powm import rangeopt_enabled
     from fsdkr_tpu.core import intops
     from fsdkr_tpu.core.secp256k1 import GENERATOR
     from fsdkr_tpu.proofs.pdl_slack import PDLwSlackStatement
@@ -873,6 +874,13 @@ def main():
             "misses_warm": cache_warm["misses"] - cache_cold["misses"],
         },
         "fsdkr_threads": native.thread_count(),
+        # range-opt provenance (ISSUE 8): which Montgomery inner loop the
+        # native core resolved (mpn = GMP asm via dlopen, portable = own
+        # u128 CIOS) and whether the shared-exponent/joint-comb/scheduler
+        # path was active — the A/B pair rangeopt_ab_n16_{on,off}.json
+        # differs in exactly this flag
+        "native_engine": native.engine_kind(),
+        "rangeopt_enabled": rangeopt_enabled(),
         # warm-collect fold statistics of the randomized batch verifier
         # (FSDKR_RLC): fullwidth_ladders must read O(rlc_groups), not
         # O(rows_folded), and bisect_fallbacks 0 on honest transcripts

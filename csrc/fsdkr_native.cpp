@@ -18,6 +18,7 @@
 #include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <dlfcn.h>
 #include <new>
 #include <thread>
 #include <vector>
@@ -81,6 +82,86 @@ int fsdkr_get_threads(void) {
   return g_threads.load(std::memory_order_relaxed);
 }
 
+} // extern "C" (reopened below; the mpn backend plumbing is C++)
+
+// ---------------------------------------------------------------------------
+// Optional GMP mpn backend for the Montgomery inner loop. The system
+// libgmp (the reference's own bigint backend, already a runtime
+// dependency of the ctypes bridge in native/gmp.py) carries asm
+// basecase multiplication and Karatsuba above ~30 limbs: at the
+// protocol's 64-limb (n^2, 4096-bit) width its mul+REDC-1 is ~2.4x the
+// portable u128 CIOS loop below, and ~2x at 32 limbs. The backend is
+// resolved at RUNTIME with dlopen/dlsym (no GMP headers in this image;
+// mp_limb_t == uint64_t on every LP64 target this builds for), and
+// every mont_mul/mont_sqr call dispatches on one relaxed atomic load:
+// results are BIT-IDENTICAL either way (same canonical residue < n), so
+// the switch (FSDKR_MPN via fsdkr_set_mpn, auto-on when libgmp
+// resolves) is a pure speed A/B, pinned by the parity suites.
+
+typedef u64 (*mpn_addmul_1_fn)(u64 *, const u64 *, long, u64);
+typedef void (*mpn_mul_n_fn)(u64 *, const u64 *, const u64 *, long);
+typedef void (*mpn_sqr_fn)(u64 *, const u64 *, long);
+typedef u64 (*mpn_sub_n_fn)(u64 *, const u64 *, const u64 *, long);
+typedef int (*mpn_cmp_fn)(const u64 *, const u64 *, long);
+typedef u64 (*mpn_redc_1_fn)(u64 *, u64 *, const u64 *, long, u64);
+
+static mpn_addmul_1_fn g_mpn_addmul_1 = nullptr;
+static mpn_mul_n_fn g_mpn_mul_n = nullptr;
+static mpn_sqr_fn g_mpn_sqr = nullptr;
+static mpn_sub_n_fn g_mpn_sub_n = nullptr;
+static mpn_cmp_fn g_mpn_cmp = nullptr;
+// internal-but-exported asm REDC (GMP keeps mpn symbols stable within a
+// soname); optional — nullptr falls back to the addmul_1 loop, which is
+// the same algorithm ~10% slower
+static mpn_redc_1_fn g_mpn_redc_1 = nullptr;
+static std::atomic<int> g_use_mpn{0};
+static std::atomic<int> g_mpn_probed{0};
+
+static int mpn_probe() { // idempotent; races only re-store identical values
+  if (g_mpn_probed.load(std::memory_order_acquire))
+    return g_mpn_addmul_1 != nullptr;
+  void *h = dlopen("libgmp.so.10", RTLD_NOW | RTLD_LOCAL);
+  if (!h)
+    h = dlopen("libgmp.so", RTLD_NOW | RTLD_LOCAL);
+  if (h) {
+    mpn_addmul_1_fn am = (mpn_addmul_1_fn)dlsym(h, "__gmpn_addmul_1");
+    mpn_mul_n_fn mn = (mpn_mul_n_fn)dlsym(h, "__gmpn_mul_n");
+    mpn_sqr_fn sq = (mpn_sqr_fn)dlsym(h, "__gmpn_sqr");
+    mpn_sub_n_fn sb = (mpn_sub_n_fn)dlsym(h, "__gmpn_sub_n");
+    mpn_cmp_fn cp = (mpn_cmp_fn)dlsym(h, "__gmpn_cmp");
+    if (am && mn && sq && sb && cp) {
+      g_mpn_mul_n = mn;
+      g_mpn_sqr = sq;
+      g_mpn_sub_n = sb;
+      g_mpn_cmp = cp;
+      g_mpn_redc_1 = (mpn_redc_1_fn)dlsym(h, "__gmpn_redc_1"); // optional
+      g_mpn_addmul_1 = am; // published last: the dispatch gates on it
+    } // a partial symbol set stays on the portable core (never dlclose:
+      // the handle must outlive every worker thread)
+  }
+  g_mpn_probed.store(1, std::memory_order_release);
+  return g_mpn_addmul_1 != nullptr;
+}
+
+extern "C" {
+
+// FSDKR_MPN bridge: n < 0 = auto (use mpn when libgmp resolves),
+// 0 = force the portable u128 core, > 0 = request mpn (granted only if
+// it resolves). Returns the active engine: 1 = mpn, 0 = portable.
+// Release store: pairs with the dispatchers' acquire loads so a thread
+// that observes g_use_mpn == 1 also observes the g_mpn_* pointer
+// stores from mpn_probe (they are plain pointers, not atomics).
+int fsdkr_set_mpn(int n) {
+  int want = (n != 0) && mpn_probe();
+  g_use_mpn.store(want ? 1 : 0, std::memory_order_release);
+  return want ? 1 : 0;
+}
+
+// 1 = GMP mpn inner loop active, 0 = portable u128 CIOS core.
+int fsdkr_engine_kind(void) {
+  return g_use_mpn.load(std::memory_order_relaxed);
+}
+
 // ---------------------------------------------------------------------------
 // limb helpers
 
@@ -126,8 +207,8 @@ static u64 mont_n0inv(u64 n0) {
 // ---------------------------------------------------------------------------
 // Montgomery CIOS multiplication: out = a * b * R^{-1} mod n, R = 2^(64 L)
 
-static void mont_mul(u64 *out, const u64 *a, const u64 *b, const u64 *n,
-                     u64 n0inv, int L) {
+static void mont_mul_cios(u64 *out, const u64 *a, const u64 *b, const u64 *n,
+                          u64 n0inv, int L) {
   u64 t[MAXL + 2];
   std::memset(t, 0, sizeof(u64) * (L + 2));
   for (int i = 0; i < L; i++) {
@@ -169,7 +250,8 @@ static void mont_mul(u64 *out, const u64 *a, const u64 *b, const u64 *n,
 // of host — and every modexp ladder is ~4 squarings per multiply, so the
 // squaring chain is where modexp wall-clock actually lives.
 
-static void mont_sqr(u64 *out, const u64 *a, const u64 *n, u64 n0inv, int L) {
+static void mont_sqr_sos(u64 *out, const u64 *a, const u64 *n, u64 n0inv,
+                         int L) {
   u64 t[2 * MAXL + 1];
   std::memset(t, 0, sizeof(u64) * (2 * L + 1));
   // cross products a_i * a_j (i < j), each summed once. t[i+L] is
@@ -229,6 +311,73 @@ static void mont_sqr(u64 *out, const u64 *a, const u64 *n, u64 n0inv, int L) {
     sub_limbs(out, t + L, n, L);
   else
     std::memcpy(out, t + L, sizeof(u64) * L);
+}
+
+// mpn-backed Montgomery product/square: schoolbook/Karatsuba product via
+// mpn_mul_n / mpn_sqr, then textbook REDC-1 (L rounds of addmul_1 by
+// m = t_i * n0inv, carries rippled into the high half), conditional
+// subtract. The intermediate t < 2n * R always fits 2L+1 limbs, and the
+// final residue is canonical (< n) exactly like the CIOS/SOS cores —
+// the two engines are interchangeable mid-ladder.
+
+static inline void mpn_redc(u64 *out, u64 *t, const u64 *n, u64 n0inv,
+                            int L) {
+  // t: 2L+1 limbs, t[2L] = 0 on entry; result < n into out
+  if (g_mpn_redc_1) {
+    u64 c = g_mpn_redc_1(out, t, n, L, n0inv);
+    if (c || g_mpn_cmp(out, n, L) >= 0)
+      g_mpn_sub_n(out, out, n, L);
+    return;
+  }
+  for (int i = 0; i < L; i++) {
+    const u64 m = t[i] * n0inv;
+    u64 c = g_mpn_addmul_1(t + i, n, L, m);
+    for (int j = i + L; c; j++) {
+      u64 s = t[j] + c;
+      c = s < c;
+      t[j] = s;
+    }
+  }
+  if (t[2 * L] != 0 || g_mpn_cmp(t + L, n, L) >= 0)
+    g_mpn_sub_n(out, t + L, n, L);
+  else
+    std::memcpy(out, t + L, sizeof(u64) * L);
+}
+
+static void mont_mul_mpn(u64 *out, const u64 *a, const u64 *b, const u64 *n,
+                         u64 n0inv, int L) {
+  u64 t[2 * MAXL + 1];
+  g_mpn_mul_n(t, a, b, L);
+  t[2 * L] = 0;
+  mpn_redc(out, t, n, n0inv, L);
+}
+
+static void mont_sqr_mpn(u64 *out, const u64 *a, const u64 *n, u64 n0inv,
+                         int L) {
+  u64 t[2 * MAXL + 1];
+  g_mpn_sqr(t, a, L);
+  t[2 * L] = 0;
+  mpn_redc(out, t, n, n0inv, L);
+}
+
+// Every ladder below calls these dispatchers; one acquire load per
+// Montgomery operation is noise against the ~L^2 limb products behind
+// it (acquire pairs with fsdkr_set_mpn's release so the g_mpn_* pointer
+// stores are visible whenever the flag reads 1, on any memory model).
+static inline void mont_mul(u64 *out, const u64 *a, const u64 *b,
+                            const u64 *n, u64 n0inv, int L) {
+  if (g_use_mpn.load(std::memory_order_acquire))
+    mont_mul_mpn(out, a, b, n, n0inv, L);
+  else
+    mont_mul_cios(out, a, b, n, n0inv, L);
+}
+
+static inline void mont_sqr(u64 *out, const u64 *a, const u64 *n, u64 n0inv,
+                            int L) {
+  if (g_use_mpn.load(std::memory_order_acquire))
+    mont_sqr_mpn(out, a, n, n0inv, L);
+  else
+    mont_sqr_sos(out, a, n, n0inv, L);
 }
 
 // R mod n and R^2 mod n by doubling (L <= MAXL)
@@ -800,6 +949,240 @@ int fsdkr_modexp_shared_w(const u64 *base, const u64 *exps, const u64 *n,
 int fsdkr_modexp_shared(const u64 *base, const u64 *exps, const u64 *n,
                         u64 *outs, int M, int L, int EL) {
   return fsdkr_modexp_shared_w(base, exps, n, outs, M, L, EL, 4);
+}
+
+// ---------------------------------------------------------------------------
+// Digit extraction on a fixed wbits grid from little-endian limbs
+// (windows may straddle a 64-bit limb).
+
+static inline u64 exp_digit(const u64 *e, int EL, int w, int wbits) {
+  long bit0 = (long)w * wbits;
+  int li = (int)(bit0 / 64), sh = (int)(bit0 % 64);
+  if (li >= EL)
+    return 0;
+  u64 d = e[li] >> sh;
+  if (sh + wbits > 64 && li + 1 < EL)
+    d |= e[li + 1] << (64 - sh);
+  return d & (((u64)1 << wbits) - 1);
+}
+
+// ---------------------------------------------------------------------------
+// Shared-exponent ladder: outs[r] = bases[r]^exp * aux_bases[r]^aux_exps[r]
+// mod n — the Alice-range u-power column shape (src/range_proofs.rs:141-148):
+// every row of a receiver's s^n column carries the SAME public exponent
+// (the receiver's Paillier modulus n) over the SAME modulus n^2, with an
+// optional per-row short second term (c^{-e}, the 256-bit challenge power)
+// riding the same squaring chain Straus-style. ONE sliding-window
+// schedule is derived from the shared exponent — per-bit squarings with
+// odd-digit multiplies at the precomputed window ends — and replayed for
+// every row; the aux term fires at fixed 4-bit grid positions of the
+// same per-bit chain (both terms' multiplies commute at a given bit
+// position, so the interleave is exact). Rows split across the
+// FSDKR_THREADS pool (independent per-row state -> bit-identical at any
+// thread count).
+//
+// Cost per row: ~top_bit squarings + ~top_bit/(wbits+1) odd-window
+// multiplies + 2^(wbits-1) odd-power table builds (+ 64 aux lookups and
+// a 14-multiply aux table when the aux term is present) — against TWO
+// independent full ladders for the split columns, and the
+// schedule/constants are amortized batch-wide. Zero-digit skipping and
+// the sliding schedule are data-dependent by design: this is a VERIFIER
+// engine over public wire integers and the public modulus (see
+// SECURITY.md "Range-opt verifier engines"); secret exponents must keep
+// to the uniform-schedule kernels (modexp_core / fsdkr_comb_apply).
+//
+// aux_bases/aux_exps may be NULL (AEL = 0): plain shared-exponent batch.
+// Callers stage bases/aux_bases already reduced below n.
+
+static inline int exp_bit(const u64 *e, int EL, int b) {
+  return b >= 0 && b < EL * 64 ? (int)((e[b / 64] >> (b % 64)) & 1) : 0;
+}
+
+int fsdkr_shared_exp_powm(const u64 *bases, const u64 *exp, const u64 *n,
+                          const u64 *aux_bases, const u64 *aux_exps,
+                          u64 *outs, int rows, int L, int EL, int AEL,
+                          int wbits) {
+  if (L <= 0 || L > MAXL || EL <= 0 || EL > 2 * MAXL || AEL < 0 ||
+      AEL > 2 * MAXL || rows <= 0 || wbits < 1 || wbits > 8 || !(n[0] & 1))
+    return -1;
+  const bool aux = aux_bases != nullptr && aux_exps != nullptr && AEL > 0;
+  const int D2 = 1 << (wbits - 1); // odd-power main table entries
+
+  // shared sliding-window schedule: main_at[b] = odd digit whose window
+  // ENDS at bit b (0 = no multiply here), windows never wider than wbits
+  int top_bit = -1;
+  for (int i = EL - 1; i >= 0 && top_bit < 0; i--)
+    if (exp[i])
+      for (int bit = 63; bit >= 0; bit--)
+        if ((exp[i] >> bit) & 1) {
+          top_bit = i * 64 + bit;
+          break;
+        }
+  const int aux_bits = aux ? AEL * 64 : 0;
+  const int H = top_bit > aux_bits - 1 ? top_bit : aux_bits - 1;
+  std::vector<u64> main_at(top_bit + 1 > 0 ? top_bit + 1 : 0, 0);
+  for (int b = top_bit; b >= 0;) {
+    if (!exp_bit(exp, EL, b)) {
+      b--;
+      continue;
+    }
+    int j = b - wbits + 1;
+    if (j < 0)
+      j = 0;
+    while (!exp_bit(exp, EL, j))
+      j++; // window ends on a set bit -> odd digit
+    u64 d = 0;
+    for (int k = b; k >= j; k--)
+      d = (d << 1) | (u64)exp_bit(exp, EL, k);
+    main_at[j] = d;
+    b = j - 1;
+  }
+
+  const u64 n0inv = mont_n0inv(n[0]);
+  u64 one_m[MAXL], r2[MAXL];
+  mont_constants(n, L, one_m, r2);
+  if (H < 0) { // exp == 0 and no aux: every row is 1
+    for (int r = 0; r < rows; r++) {
+      std::memset(outs + (size_t)r * L, 0, sizeof(u64) * L);
+      outs[(size_t)r * L] = 1;
+    }
+    return 0;
+  }
+
+  std::atomic<int> rc{0};
+  parallel_rows(rows, [&](int lo, int hi) {
+    // T_odd[k] = base^(2k+1); A[d] = aux_base^d (4-bit grid, both
+    // parities — aux digits are per-row data, the table build is 14
+    // multiplies against 64 lookups)
+    u64 *table = new (std::nothrow) u64[((size_t)D2 + (aux ? 16 : 0)) * MAXL];
+    if (!table) {
+      rc.store(-1, std::memory_order_relaxed);
+      return;
+    }
+    u64 *atab = table + (size_t)D2 * MAXL;
+    auto T = [&](int k) { return table + (size_t)k * MAXL; };
+    auto A = [&](int d) { return atab + (size_t)d * MAXL; };
+    u64 b[MAXL], base_m[MAXL], base2[MAXL], acc[MAXL], onev[MAXL];
+    std::memset(onev, 0, sizeof(u64) * MAXL);
+    onev[0] = 1;
+    for (int r = lo; r < hi; r++) {
+      // main-term odd-power table (base already reduced by the bridge)
+      std::memcpy(b, bases + (size_t)r * L, sizeof(u64) * L);
+      while (cmp_limbs(b, n, L) >= 0)
+        sub_limbs(b, b, n, L);
+      mont_mul(base_m, b, r2, n, n0inv, L);
+      std::memcpy(T(0), base_m, sizeof(u64) * L);
+      if (D2 > 1) {
+        mont_sqr(base2, base_m, n, n0inv, L);
+        for (int k = 1; k < D2; k++)
+          mont_mul(T(k), T(k - 1), base2, n, n0inv, L);
+      }
+      const u64 *ae = aux ? aux_exps + (size_t)r * AEL : nullptr;
+      bool has_aux = false;
+      if (aux) {
+        for (int i = 0; i < AEL && !has_aux; i++)
+          has_aux = ae[i] != 0;
+        if (has_aux) {
+          std::memcpy(b, aux_bases + (size_t)r * L, sizeof(u64) * L);
+          while (cmp_limbs(b, n, L) >= 0)
+            sub_limbs(b, b, n, L);
+          mont_mul(base_m, b, r2, n, n0inv, L);
+          std::memcpy(A(0), one_m, sizeof(u64) * L);
+          std::memcpy(A(1), base_m, sizeof(u64) * L);
+          for (int d = 2; d < 16; d++) {
+            if (d % 2 == 0)
+              mont_sqr(A(d), A(d / 2), n, n0inv, L);
+            else
+              mont_mul(A(d), A(d - 1), base_m, n, n0inv, L);
+          }
+        }
+      }
+      // per-bit chain: squarings every bit, main multiply where a
+      // window ends, aux multiply at 4-aligned positions — same-bit
+      // multiplies commute, so the interleave equals the two ladders
+      bool started = false;
+      for (int bi = H; bi >= 0; bi--) {
+        if (started)
+          mont_sqr(acc, acc, n, n0inv, L);
+        const u64 dm = bi <= top_bit ? main_at[bi] : 0;
+        if (dm) {
+          if (!started) {
+            std::memcpy(acc, T((int)(dm >> 1)), sizeof(u64) * L);
+            started = true;
+          } else
+            mont_mul(acc, acc, T((int)(dm >> 1)), n, n0inv, L);
+        }
+        if (has_aux && (bi & 3) == 0 && bi < aux_bits) {
+          const u64 da = exp_digit(ae, AEL, bi / 4, 4);
+          if (da) {
+            if (!started) {
+              std::memcpy(acc, A((int)da), sizeof(u64) * L);
+              started = true;
+            } else
+              mont_mul(acc, acc, A((int)da), n, n0inv, L);
+          }
+        }
+      }
+      if (!started)
+        std::memcpy(acc, one_m, sizeof(u64) * L);
+      mont_mul(outs + (size_t)r * L, acc, onev, n, n0inv, L);
+    }
+    secure_wipe(acc, MAXL); // consistency with the other frames; all
+    secure_wipe(b, MAXL);   // operands here are public wire data
+    secure_wipe(base_m, MAXL);
+    secure_wipe(base2, MAXL);
+    secure_wipe(table, (D2 + (aux ? 16 : 0)) * MAXL);
+    delete[] table;
+  });
+  return rc.load();
+}
+
+// ---------------------------------------------------------------------------
+// Fused two-table comb apply: outs[m] = T1-base^exps1[m] * T2-base^exps2[m]
+// mod n — the h1^s1 * h2^s2 mod N~ shape of the range/PDL mod-N~ equations
+// (src/range_proofs.rs:133-139), as ONE pass per row over BOTH persistent
+// window tables (fsdkr_comb_precompute geometry, cached cross-epoch in the
+// Python LRU for public bases) with a single Montgomery exit — eliminating
+// the separate columns and the recombination modmul. Tables may carry
+// different geometries (EL, wbits). Zero digits skip (public wire
+// exponents; see fsdkr_shared_exp_powm's note). Rows split across the
+// FSDKR_THREADS pool.
+
+int fsdkr_comb2_apply(const u64 *table1, const u64 *exps1, int EL1, int w1,
+                      const u64 *table2, const u64 *exps2, int EL2, int w2,
+                      const u64 *n, u64 *outs, int M, int L) {
+  const int W1 = comb_windows(L, EL1, w1, n);
+  const int W2 = comb_windows(L, EL2, w2, n);
+  if (W1 < 0 || W2 < 0 || M <= 0)
+    return -1;
+  const int D1 = 1 << w1, D2 = 1 << w2;
+  const u64 n0inv = mont_n0inv(n[0]);
+  const u64 *one_m = table1; // T(0, 0) is the Montgomery one
+  auto T1 = [&](int w, int d) { return table1 + ((size_t)w * D1 + d) * L; };
+  auto T2 = [&](int w, int d) { return table2 + ((size_t)w * D2 + d) * L; };
+  parallel_rows(M, [&](int lo, int hi) {
+    u64 acc[MAXL], onev[MAXL];
+    std::memset(onev, 0, sizeof(u64) * MAXL);
+    onev[0] = 1;
+    for (int m = lo; m < hi; m++) {
+      const u64 *e1 = exps1 + (size_t)m * EL1;
+      const u64 *e2 = exps2 + (size_t)m * EL2;
+      std::memcpy(acc, one_m, sizeof(u64) * L);
+      for (int w = 0; w < W1; w++) {
+        u64 d = exp_digit(e1, EL1, w, w1);
+        if (d)
+          mont_mul(acc, acc, T1(w, (int)d), n, n0inv, L);
+      }
+      for (int w = 0; w < W2; w++) {
+        u64 d = exp_digit(e2, EL2, w, w2);
+        if (d)
+          mont_mul(acc, acc, T2(w, (int)d), n, n0inv, L);
+      }
+      mont_mul(outs + (size_t)m * L, acc, onev, n, n0inv, L);
+    }
+    secure_wipe(acc, MAXL);
+  });
+  return 0;
 }
 
 // ---------------------------------------------------------------------------

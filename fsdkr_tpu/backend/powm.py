@@ -364,6 +364,116 @@ def multiexp_enabled() -> bool:
     )
 
 
+def rangeopt_enabled() -> bool:
+    """FSDKR_RANGEOPT gates the range-family verifier optimizations
+    (shared-exponent ladders for the s^n mod n^2 column, the joint
+    fixed-base comb for h1^s1*h2^s2 mod N~, and the concurrent column
+    scheduler in tpu_verifier.verify_pairs): =0 reverts the range family
+    to the per-row joint/column path for A/B isolation. Verdicts and
+    identifiable-abort blame are bit-identical either way
+    (tests/test_range_engines.py). Read at call time so the bench
+    battery can toggle it per step."""
+    return _os.environ.get("FSDKR_RANGEOPT", "1").lower() not in (
+        "0", "off", "false", "no",
+    )
+
+
+def tpu_powm_shared_exp(bases, exp, modulus, aux_bases=None, aux_exps=None):
+    """Shared-exponent column: bases[r]^exp (* aux_bases[r]^aux_exps[r])
+    mod modulus — ONE public exponent and modulus across the whole batch
+    (the Alice-range u-power shape: every row of a receiver's s^n column
+    raises a different wire base to the receiver's public Paillier n).
+
+    Host route: the native shared-schedule threaded engine
+    (native.shared_exp_powm; GMP mpn inner loop when present), which
+    folds the optional per-row short term into the one squaring chain.
+    Device route: the rows x limbs shared-exponent kernel
+    (ops.montgomery.shared_exp_modexp) — the digit schedule is a dynamic
+    input, so committees share compiled kernels per shape bucket — with
+    the aux term through the generic windowed kernel and a batched
+    modmul recombine. Mesh launches ride the sharded generic kernel
+    (exponent replicated row-wise): correctness-identical, and the
+    sharded path keeps its own tuning."""
+    rows = len(bases)
+    if rows == 0:
+        return []
+    if not _device_powm():
+        from .. import native
+
+        if native.available():
+            from ..utils.roofline import stamp_generic_host
+            from ..utils.trace import get_tracer
+
+            if get_tracer().enabled:
+                mod_bits = modulus.bit_length()
+                stamp_generic_host(rows, mod_bits, mod_bits)
+            return native.shared_exp_powm(
+                bases, exp, modulus, aux_bases, aux_exps
+            )
+        out = host_powm(bases, [exp] * rows, [modulus] * rows)
+        if aux_bases is not None:
+            ap = host_powm(aux_bases, aux_exps, [modulus] * rows)
+            out = [x * y % modulus for x, y in zip(out, ap)]
+        return out
+    from ..ops.limbs import bucket_exp_bits, limbs_for_bits
+    from ..utils.roofline import generic_modexp_macs
+    from ..utils.trace import get_tracer
+
+    if _MESH is not None or rows > _MAX_ROWS:
+        # sharded/tiled launches keep the generic per-row kernel path
+        out = tpu_powm(bases, [exp] * rows, [modulus] * rows)
+    else:
+        from ..ops.montgomery import shared_exp_modexp
+
+        pad = _pad_pow2(rows) - rows
+        padded = list(bases) + [1] * pad
+        k = limbs_for_bits(modulus.bit_length())
+        get_tracer().add_macs(
+            generic_modexp_macs(len(padded), bucket_exp_bits([exp]), k)
+        )
+        ctx = _cached_ctx([modulus] * len(padded), k)
+        out = shared_exp_modexp(
+            padded, exp, modulus, k, ctx=ctx
+        )[:rows]
+    if aux_bases is not None:
+        ap = tpu_powm(list(aux_bases), list(aux_exps), [modulus] * rows)
+        out = tpu_modmul(out, ap, [modulus] * rows)
+    return out
+
+
+def joint_comb2(base1, exps1, base2, exps2, modulus):
+    """base1^exps1[r] * base2^exps2[r] mod modulus — the 2-term
+    fixed-base shape of the mod-N~ equations (h1^s1 * h2^s2 per receiver
+    environment), as a single joint comb apply: one pass over both
+    persistent window tables per row, one Montgomery exit, no separate
+    columns and no recombination modmul. Tables persist cross-epoch in
+    the public-base LRU (native._cached_comb_table — PUBLIC bases only).
+    Device route: both groups in one comb launch + a batched modmul."""
+    rows = len(exps1)
+    if rows == 0:
+        return []
+    if len(exps2) != rows:
+        raise ValueError("joint_comb2 column length mismatch")
+    if not _device_powm():
+        from .. import native
+        from ..utils.roofline import stamp_shared_host
+        from ..utils.trace import get_tracer
+
+        if get_tracer().enabled:
+            mod_bits = modulus.bit_length()
+            stamp_shared_host(2, rows, mod_bits, mod_bits)
+        res = native.comb2_apply(base1, exps1, base2, exps2, modulus)
+        if res is not None:
+            return res
+        r1 = native.modexp_shared(base1, list(exps1), modulus)
+        r2 = native.modexp_shared(base2, list(exps2), modulus)
+        return [a * b % modulus for a, b in zip(r1, r2)]
+    r1, r2 = tpu_powm_shared(
+        [base1, base2], [list(exps1), list(exps2)], [modulus, modulus]
+    )
+    return tpu_modmul(r1, r2, [modulus] * rows)
+
+
 def batch_base_inv(values, moduli):
     """Montgomery-trick batched modular inversion on the host: rows group
     by modulus, one `pow(prod, -1, m)` per group plus ~3 bigint mulmods

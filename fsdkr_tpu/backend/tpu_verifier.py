@@ -554,6 +554,53 @@ class TpuBatchVerifier(BatchVerifier):
                 out[i] = vi
         return out
 
+    def _range_gate(self, items):
+        """Shared row gating of the range family: domain-gate every row
+        (AliceProof.domain_gate, including the q^3 slack bound on s1)
+        and zero the challenge of gated rows. ONE implementation for
+        the column/joint and rangeopt paths — the FSDKR_RANGEOPT=0/1
+        verdict-identity contract depends on both paths gating
+        identically."""
+        nn_mod = [ek.nn for _, _, ek, _ in items]
+        nt_mod = [dlog.N for _, _, _, dlog in items]
+        row_ok = [
+            alice_range.AliceProof.domain_gate(p, c, dlog)
+            for p, c, _, dlog in items
+        ]
+        e_vec = [
+            p.e if ok else 0 for (p, _, _, _), ok in zip(items, row_ok)
+        ]
+        return nn_mod, nt_mod, row_ok, e_vec
+
+    def _range_base_inv(self, items, nn_mod, nt_mod, row_ok, e_vec):
+        """Shared batched base inversions of the range family (z mod N~,
+        c mod n^2) for the live e != 0 rows; e == 0 rows never invert
+        (x^0 = 1 is always invertible, matching the host oracle).
+        Returns (z_inv, c_inv, inv_fail) — a non-invertible z or c
+        (gcd > 1, adversarial) marks only its own row, which the caller
+        force-fails exactly like the host oracle. ONE implementation for
+        the joint and rangeopt paths (see _range_gate)."""
+        from .powm import batch_base_inv
+
+        rows = len(items)
+        need = [i for i in range(rows) if row_ok[i] and e_vec[i] != 0]
+        with phase("range.base_inv", items=2 * len(need)):
+            z_invs = batch_base_inv(
+                [items[i][0].z for i in need], [nt_mod[i] for i in need]
+            )
+            c_invs = batch_base_inv(
+                [items[i][1] for i in need], [nn_mod[i] for i in need]
+            )
+        z_inv = [1] * rows
+        c_inv = [1] * rows
+        inv_fail = [False] * rows
+        for i, zv, cv in zip(need, z_invs, c_invs):
+            if zv is None or cv is None:
+                inv_fail[i] = True  # verdict False, like the host oracle
+            else:
+                z_inv[i], c_inv[i] = zv, cv
+        return z_inv, c_inv, inv_fail
+
     def _range_prepare(self, items, joint: bool = False):
         """Return (the family's modexp columns, carry state for
         _range_finish). Column order matches _range_finish.
@@ -576,15 +623,7 @@ class TpuBatchVerifier(BatchVerifier):
         > 1 or gcd(c, n^2) > 1 fails the row exactly as the host oracle
         (mod_inv -> None) and the column path (product-tree fallback)
         do."""
-        nn_mod = [ek.nn for _, _, ek, _ in items]
-        nt_mod = [dlog.N for _, _, _, dlog in items]
-        row_ok = [
-            alice_range.AliceProof.domain_gate(p, c, dlog)
-            for p, c, _, dlog in items
-        ]
-        e_vec = [
-            p.e if ok else 0 for (p, _, _, _), ok in zip(items, row_ok)
-        ]
+        nn_mod, nt_mod, row_ok, e_vec = self._range_gate(items)
         s1_col = [
             p.s1 if ok else 0 for (p, _, _, _), ok in zip(items, row_ok)
         ]
@@ -606,24 +645,9 @@ class TpuBatchVerifier(BatchVerifier):
                     nn_mod,
                 ),
             ), (nn_mod, nt_mod, row_ok, None)
-        from .powm import batch_base_inv
-
-        need = [i for i in range(len(items)) if row_ok[i] and e_vec[i] != 0]
-        with phase("range.base_inv", items=2 * len(need)):
-            z_invs = batch_base_inv(
-                [items[i][0].z for i in need], [nt_mod[i] for i in need]
-            )
-            c_invs = batch_base_inv(
-                [items[i][1] for i in need], [nn_mod[i] for i in need]
-            )
-        z_inv = [1] * len(items)
-        c_inv = [1] * len(items)
-        inv_fail = [False] * len(items)
-        for i, zv, cv in zip(need, z_invs, c_invs):
-            if zv is None or cv is None:
-                inv_fail[i] = True  # verdict False, like the host oracle
-            else:
-                z_inv[i], c_inv[i] = zv, cv
+        z_inv, c_inv, inv_fail = self._range_base_inv(
+            items, nn_mod, nt_mod, row_ok, e_vec
+        )
         live = [ok and not fail for ok, fail in zip(row_ok, inv_fail)]
         e_live = [e if lv else 0 for e, lv in zip(e_vec, live)]
         multi = (
@@ -650,7 +674,15 @@ class TpuBatchVerifier(BatchVerifier):
 
         with phase("range.combine", items=len(items)):
             w_part = _modmul(h1_s1, h2_s2, nt_mod)
-            gs1 = [(1 + p.s1 * ek.n) % ek.nn for p, _, ek, _ in items]
+            # domain-gated rows are force-failed below and must be
+            # skipped HERE: an adversarial s1 on a gated row can be
+            # arbitrarily wide (multi-megabit), and building its
+            # (1 + s1*n) % nn anyway would burn a giant host multiply
+            # per dead row (tests/test_wire_negative.py pins this)
+            gs1 = [
+                (1 + p.s1 * ek.n) % ek.nn if ok else 1
+                for (p, _, ek, _), ok in zip(items, row_ok)
+            ]
             if inv_fail is None:
                 u_part = _modmul(gs1, s_n, nn_mod)
             else:
@@ -691,11 +723,145 @@ class TpuBatchVerifier(BatchVerifier):
                 )
         return out
 
+    # -- FSDKR_RANGEOPT: shared-exponent / joint-comb range engines ----
+    def _range_opt_prepare(self, items):
+        """Gate rows, batch the base inversions, and group live rows by
+        receiver environment for the structure-exploiting engines:
+
+        - the mod-n^2 u-power u = gs1 * s^n * c^{-e}: every row of a
+          receiver's group shares the receiver's PUBLIC 2048-bit
+          exponent n (and modulus n^2), so the group runs as ONE
+          square-and-multiply schedule through the shared-exponent
+          engine (backend.powm.tpu_powm_shared_exp), the c^{-e} term
+          riding the same chain Straus-style;
+        - the mod-N~ w-part h1^s1 * h2^s2: a 2-term fixed-base shape per
+          receiver environment, ONE joint comb apply over both
+          persistent window tables (backend.powm.joint_comb2);
+        - the z^{-e} column stays a generic 256-bit launch.
+
+        Out-of-domain rows (AliceProof.domain_gate, including the q^3
+        slack bound on s1) and rows whose z/c is non-invertible are
+        NEVER staged — no group contains a dead row, and in particular
+        no gs1 is ever built from an ungated (potentially multi-megabit)
+        s1. Verdicts are bit-identical to the joint/column paths —
+        gating and inversion semantics are literally shared code
+        (_range_gate / _range_base_inv; tests/test_range_engines.py)."""
+        rows = len(items)
+        nn_mod, nt_mod, row_ok, e_vec = self._range_gate(items)
+        z_inv, c_inv, inv_fail = self._range_base_inv(
+            items, nn_mod, nt_mod, row_ok, e_vec
+        )
+        live = [
+            ok and not fail for ok, fail in zip(row_ok, inv_fail)
+        ]
+        nn_groups: Dict[tuple, List[int]] = {}
+        nt_groups: Dict[tuple, List[int]] = {}
+        for i in range(rows):
+            if not live[i]:
+                continue
+            _, _, ek, dlog = items[i]
+            nn_groups.setdefault((ek.n, ek.nn), []).append(i)
+            nt_groups.setdefault((dlog.g, dlog.ni, dlog.N), []).append(i)
+        return dict(
+            nn_mod=nn_mod, nt_mod=nt_mod, row_ok=row_ok, e_vec=e_vec,
+            z_inv=z_inv, c_inv=c_inv, live=live,
+            nn_groups=nn_groups, nt_groups=nt_groups,
+            u_pow=[1] * rows, hs=[1] * rows, z_pow=[1] * rows,
+        )
+
+    def _range_opt_jobs(self, items, state):
+        """Independent launch-group thunks for the concurrent column
+        scheduler (utils.pipeline.run_jobs): one shared-exponent job per
+        mod-n^2 receiver group, one joint-comb job per mod-N~ receiver
+        environment, and one generic z^{-e} column job. Each thunk
+        writes only its own rows of the state vectors, so any execution
+        order/interleaving produces identical results."""
+        from .powm import joint_comb2, tpu_powm_shared_exp
+
+        e_vec, c_inv, z_inv = state["e_vec"], state["c_inv"], state["z_inv"]
+        live = state["live"]
+        jobs = []
+        for (n, nn), idxs in state["nn_groups"].items():
+            def u_job(n=n, nn=nn, idxs=idxs):
+                with phase("range.u_pow", items=len(idxs)):
+                    res = tpu_powm_shared_exp(
+                        [items[i][0].s for i in idxs], n, nn,
+                        aux_bases=[c_inv[i] for i in idxs],
+                        aux_exps=[e_vec[i] for i in idxs],
+                    )
+                for i, v in zip(idxs, res):
+                    state["u_pow"][i] = v
+
+            jobs.append(u_job)
+        for (h1, h2, nt), idxs in state["nt_groups"].items():
+            def w_job(h1=h1, h2=h2, nt=nt, idxs=idxs):
+                with phase("range.comb2", items=len(idxs)):
+                    res = joint_comb2(
+                        h1, [items[i][0].s1 for i in idxs],
+                        h2, [items[i][0].s2 for i in idxs], nt,
+                    )
+                for i, v in zip(idxs, res):
+                    state["hs"][i] = v
+
+            jobs.append(w_job)
+        z_rows = [i for i in range(len(items)) if live[i] and e_vec[i]]
+        if z_rows:
+            def z_job():
+                with phase("range.z_e", items=len(z_rows)):
+                    res = _modexp(
+                        [z_inv[i] for i in z_rows],
+                        [e_vec[i] for i in z_rows],
+                        [state["nt_mod"][i] for i in z_rows],
+                    )
+                for i, v in zip(z_rows, res):
+                    state["z_pow"][i] = v
+
+            jobs.append(z_job)
+        return jobs
+
+    def _range_opt_finish(self, items, state):
+        """Combine the scheduled launch groups' results into verdicts:
+        u = gs1 * u_pow mod n^2, w = hs * z_pow mod N~, then the
+        Fiat-Shamir challenge recomputation per live row."""
+        live, e_vec = state["live"], state["e_vec"]
+        idxs = [i for i in range(len(items)) if live[i]]
+        with phase("range.combine", items=len(idxs)):
+            # gs1 only for live rows: s1 <= q^3 here BY the domain gate
+            gs1 = [
+                (1 + items[i][0].s1 * items[i][2].n) % items[i][2].nn
+                for i in idxs
+            ]
+            u_col = _modmul(
+                gs1, [state["u_pow"][i] for i in idxs],
+                [state["nn_mod"][i] for i in idxs],
+            )
+            w_col = _modmul(
+                [state["hs"][i] for i in idxs],
+                [state["z_pow"][i] for i in idxs],
+                [state["nt_mod"][i] for i in idxs],
+            )
+        out = [False] * len(items)
+        with phase("range.challenge", items=len(idxs)):
+            for i, u, w in zip(idxs, u_col, w_col):
+                proof, cipher, ek, _ = items[i]
+                out[i] = (
+                    alice_range._challenge(
+                        ek.n, cipher, proof.z, u, w, self.config.hash_alg
+                    )
+                    == proof.e
+                )
+        return out
+
     def verify_range(self, items):
         if not items:
             return []
-        from .powm import multiexp_enabled, powm_columns
+        from ..utils.pipeline import run_jobs
+        from .powm import multiexp_enabled, powm_columns, rangeopt_enabled
 
+        if rangeopt_enabled():
+            state = self._range_opt_prepare(items)
+            run_jobs(self._range_opt_jobs(items, state))
+            return self._range_opt_finish(items, state)
         cols, mods = self._range_prepare(items, joint=multiexp_enabled())
         with phase("range.modexp_columns", items=len(cols) * len(items)):
             results = powm_columns(_modexp, *cols)
@@ -712,8 +878,8 @@ class TpuBatchVerifier(BatchVerifier):
         small committees underfeed the chip."""
         if not pdl_items or not range_items:
             return super().verify_pairs(pdl_items, range_items)
-        from ..utils.pipeline import submit_bg
-        from .powm import multiexp_enabled, powm_columns
+        from ..utils.pipeline import run_jobs, submit_bg
+        from .powm import multiexp_enabled, powm_columns, rangeopt_enabled
         from .rlc import rlc_enabled
 
         joint = multiexp_enabled()
@@ -729,11 +895,39 @@ class TpuBatchVerifier(BatchVerifier):
         else:
             pcols, state = self._pdl_prepare(pdl_items, joint=joint)
             pdl_finish = self._pdl_finish
-        rcols, rmods = self._range_prepare(range_items, joint=joint)
         # overlap the host EC u1 column with the fused modexp launch set
         # (see verify_pdl)
         e_vec = state[0]
         u1_fut = submit_bg(lambda: self._pdl_u1_batch(pdl_items, e_vec))
+        if rangeopt_enabled():
+            # FSDKR_RANGEOPT concurrent column scheduler: the PDL fold
+            # columns, each receiver's mod-n^2 shared-exponent group,
+            # each receiver environment's mod-N~ joint comb, and the
+            # z^{-e} column are independent launch sets — run them
+            # through the scheduler pool (sequential and bit-identical
+            # at 1 worker) instead of one serial powm_columns chain.
+            rstate = self._range_opt_prepare(range_items)
+            presults = [None]
+
+            def pdl_job():
+                with phase(
+                    "pdl.modexp_columns",
+                    items=len(pcols) * len(pdl_items),
+                ):
+                    presults[0] = powm_columns(_modexp, *pcols)
+
+            jobs = [pdl_job] + self._range_opt_jobs(range_items, rstate)
+            n_rows = len(pcols) * len(pdl_items) + len(range_items)
+            with phase("pairs.modexp_columns", items=n_rows):
+                run_jobs(jobs)
+            return (
+                pdl_finish(
+                    pdl_items, state, presults[0],
+                    u1_vec=u1_fut.result() if u1_fut is not None else None,
+                ),
+                self._range_opt_finish(range_items, rstate),
+            )
+        rcols, rmods = self._range_prepare(range_items, joint=joint)
         n_rows = len(pcols) * len(pdl_items) + len(rcols) * len(range_items)
         with phase("pairs.modexp_columns", items=n_rows):
             results = powm_columns(_modexp, *pcols, *rcols)

@@ -56,13 +56,16 @@ class NativeLib:
         symbols: Sequence[str],
         env_var: Optional[str] = None,
         thread_symbol: Optional[str] = None,
+        mpn_symbol: Optional[str] = None,
     ):
         self._src = src
         self._prefix = prefix
         self._symbols = list(symbols)
         self._env_var = env_var
         self._thread_symbol = thread_symbol
+        self._mpn_symbol = mpn_symbol
         self._applied_threads: Optional[str] = None
+        self._applied_mpn: Optional[str] = None
         self._lib: Optional[ctypes.CDLL] = None
         self._tried = False
         self._lock = threading.Lock()
@@ -91,10 +94,13 @@ class NativeLib:
             os.close(fd)
             # -pthread is load-bearing on glibc < 2.34 (this image ships
             # 2.31): std::thread in a dlopened .so without it aborts at
-            # the first spawn instead of failing the link
+            # the first spawn instead of failing the link. -ldl likewise:
+            # the bignum core resolves the optional GMP mpn backend with
+            # dlopen at runtime (csrc/fsdkr_native.cpp, FSDKR_MPN), and
+            # pre-2.34 glibc keeps dlopen in libdl.
             cmd = [
                 "g++", "-O3", "-march=native", "-shared", "-fPIC",
-                "-pthread", "-o", tmp, src,
+                "-pthread", "-o", tmp, src, "-ldl",
             ]
             try:
                 subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -145,14 +151,23 @@ class NativeLib:
         if lib is None:
             return
         val = os.environ.get("FSDKR_THREADS", "0").strip().lower() or "0"
-        if val == self._applied_threads:
-            return
-        try:
-            n = int(val)
-        except ValueError:
-            n = 0  # "auto" (or anything unparseable) -> all cores
-        getattr(lib, self._thread_symbol)(n)
-        self._applied_threads = val
+        if val != self._applied_threads:
+            try:
+                n = int(val)
+            except ValueError:
+                n = 0  # "auto" (or anything unparseable) -> all cores
+            getattr(lib, self._thread_symbol)(n)
+            self._applied_threads = val
+        if self._mpn_symbol is not None:
+            # FSDKR_MPN: auto (default) resolves the GMP mpn inner loop
+            # when libgmp is present, 0 forces the portable u128 core —
+            # a pure-speed A/B, results bit-identical (csrc dispatch)
+            mval = os.environ.get("FSDKR_MPN", "auto").strip().lower() or "auto"
+            if mval != self._applied_mpn:
+                getattr(lib, self._mpn_symbol)(
+                    0 if mval in ("0", "off", "false", "no") else -1
+                )
+                self._applied_mpn = mval
 
 
 _REGISTRY: Dict[str, NativeLib] = {}
@@ -164,11 +179,12 @@ def get_lib(
     symbols: Sequence[str],
     env_var: Optional[str] = None,
     thread_symbol: Optional[str] = None,
+    mpn_symbol: Optional[str] = None,
 ) -> NativeLib:
     """Process-wide NativeLib per prefix (so repeated imports share one
     build attempt)."""
     if prefix not in _REGISTRY:
         _REGISTRY[prefix] = NativeLib(
-            src, prefix, symbols, env_var, thread_symbol
+            src, prefix, symbols, env_var, thread_symbol, mpn_symbol
         )
     return _REGISTRY[prefix]

@@ -52,6 +52,7 @@ __all__ = [
     "bucket_exp_bits",
     "BatchModExp",
     "shared_base_modexp",
+    "shared_exp_modexp",
     "multi_modexp",
 ]
 
@@ -176,6 +177,90 @@ def _modexp_kernel(base, exp, n, n_prime, r2, one_mont, *, exp_bits):
     # leave Montgomery domain: multiply by 1
     one = jnp.zeros_like(acc).at[:, 0].set(1)
     return mont_mul_limbs(acc, one, n, n_prime)
+
+
+@partial(jax.jit, static_argnames=("n_windows",))
+def _shared_exp_kernel(base, digits, n, n_prime, r2, one_mont, *, n_windows):
+    """result[b] = base[b]^E mod n[b] for ONE shared exponent E whose
+    4-bit window digits (MSB-first) arrive as a dynamic i32 vector — the
+    Alice-range s^n column shape (FSDKR_RANGEOPT): every row of a
+    receiver's column raises a different base to the receiver's PUBLIC
+    Paillier modulus n, so the whole batch replays one square-and-
+    multiply schedule as per-step row-parallel Montgomery muls over the
+    rows x limbs tensors.
+
+    Against the generic `_modexp_kernel` this drops the per-row (B, EL)
+    exponent tensor and its per-row one-hot digit compare: the digit is
+    ONE traced scalar per window, so the table select is a single
+    dynamic index shared by every row. Digits are DYNAMIC inputs (the
+    schedule is data, not shape): committees with different moduli reuse
+    one compiled kernel per (rows, limbs, n_windows) bucket. The digit
+    schedule derives from the public modulus only — no per-row wire data
+    enters it (SECURITY.md "Range-opt verifier engines").
+    """
+    base_m = mont_mul_limbs(base, r2, n, n_prime)
+
+    def build(j, table):
+        prev = table[j - 1]
+        table = table.at[j].set(mont_mul_limbs(prev, base_m, n, n_prime))
+        return table
+
+    table0 = jnp.zeros((1 << _WINDOW,) + base.shape, _U32)
+    table0 = table0.at[0].set(one_mont).at[1].set(base_m)
+    table = lax.fori_loop(2, 1 << _WINDOW, build, table0)
+
+    def step(wi, acc):
+        for _ in range(_WINDOW):
+            acc = mont_mul_limbs(acc, acc, n, n_prime)
+        sel = lax.dynamic_index_in_dim(table, digits[wi], axis=0,
+                                       keepdims=False)
+        return mont_mul_limbs(acc, sel, n, n_prime)
+
+    acc = lax.fori_loop(0, n_windows, step, one_mont)
+    one = jnp.zeros_like(acc).at[:, 0].set(1)
+    return mont_mul_limbs(acc, one, n, n_prime)
+
+
+def shared_exp_modexp(
+    bases: Sequence[int],
+    exp: int,
+    modulus: int,
+    num_limbs: int,
+    ctx=None,
+    mesh=None,
+) -> List[int]:
+    """bases[r]^exp mod modulus through the shared-exponent device
+    kernel: one shared PUBLIC exponent/modulus, per-row bases. The window
+    schedule (4-bit digits, MSB-first) is computed on the host from the
+    shared exponent and shipped as a dynamic vector. Mesh sharding rides
+    the caller's generic fallback (backend.powm routes mesh launches to
+    the per-row kernel), so this entry is single-device."""
+    rows = len(bases)
+    if rows == 0:
+        return []
+    if exp < 0:
+        raise ValueError("shared_exp_modexp: exponent must be non-negative")
+    exp_bits = bucket_exp_bits([exp])
+    n_windows = exp_bits // _WINDOW
+    digits = np.zeros((max(1, n_windows),), dtype=np.int32)
+    for w in range(n_windows):
+        shift = exp_bits - _WINDOW * (w + 1)
+        digits[w] = (exp >> shift) & ((1 << _WINDOW) - 1)
+    if ctx is None:
+        ctx = BatchModExp([modulus] * rows, num_limbs)
+    base_limbs = ints_to_limbs([b % modulus for b in bases], num_limbs)
+    out = _shared_exp_kernel(
+        jnp.asarray(base_limbs),
+        jnp.asarray(digits),
+        ctx._n,
+        ctx._n_prime,
+        ctx._r2,
+        ctx._one_mont,
+        n_windows=n_windows,
+    )
+    res = limbs_to_ints(np.asarray(out))
+    wipe_array(base_limbs)
+    return res
 
 
 def _comb_tree_chunk(

@@ -1,4 +1,4 @@
-"""Bob's MtA / MtAwc range proofs.
+"""Bob's MtA / MtAwc range proofs. PROTOCOL-DEAD in the refresh.
 
 Re-derivation of the reference's `BobProof` / `BobProofExt`
 (`/root/reference/src/range_proofs.rs:206-590`). These are protocol-dead in
@@ -6,6 +6,16 @@ the refresh itself (SURVEY.md §5 quirk 9 — kept for GG20 MtA
 compatibility) but are part of the capability surface, and this framework's
 GG20-style signing harness (`fsdkr_tpu.protocol.signing`) actually uses the
 MtA algebra they attest to.
+
+EXPLICIT DEAD-CODE MARKER (ISSUE 8 satellite): no collect()/verify_pairs
+path constructs or verifies these proofs, and none of the batched
+verifier families (backend.tpu_verifier) may grow a BobProof column
+without first wiring domain gates + batch staging like the live
+families — the per-row `verify` below is host-oracle-only. The module
+is kept importable and round-tripping by
+tests/test_range_engines.py::test_bob_range_importable_and_roundtrips
+(cheap guard) and tests/test_proofs.py::TestBobRange (full MtA flow),
+so it cannot silently rot or get pulled into the verifier by accident.
 
 Statement: Alice's ciphertext c_a = Enc_ek(a), MtA output
 c_out = b * c_a (+) Enc_ek(beta_prim, r). Bob proves b < q^3 (slack) and

@@ -184,6 +184,53 @@ def measure_comb(kind, bits, exp_bits, groups, rows_per_group, spot_check=True):
     return rec
 
 
+def measure_shared_exp(kind, bits, exp_bits, rows, spot_check=True):
+    """Shared-exponent engines (FSDKR_RANGEOPT): ONE public exponent and
+    modulus, per-row bases — the Alice-range s^n column shape. kinds:
+    sharedexp-cios (rows x limbs device kernel, digit schedule as a
+    dynamic vector) and sharedexp-native (host shared-schedule engine,
+    GMP mpn inner loop when present)."""
+    import random
+
+    from fsdkr_tpu.ops.limbs import limbs_for_bits
+
+    rng = random.Random(17)
+    mod = rng.getrandbits(bits) | (1 << (bits - 1)) | 1
+    exp = rng.getrandbits(exp_bits) | (1 << (exp_bits - 1))
+    bases = [rng.getrandbits(bits - 1) for _ in range(rows)]
+    if kind == "sharedexp-cios":
+        from fsdkr_tpu.ops.montgomery import shared_exp_modexp
+
+        run = lambda: shared_exp_modexp(
+            bases, exp, mod, limbs_for_bits(bits)
+        )
+    elif kind == "sharedexp-native":
+        from fsdkr_tpu import native
+
+        run = lambda: native.shared_exp_powm(bases, exp, mod)
+    else:
+        raise ValueError(kind)
+    out = run()  # correctness + compile
+    if spot_check:
+        for i in (0, rows // 2, rows - 1):
+            assert out[i] == pow(bases[i] % mod, exp, mod), (
+                f"{kind} wrong at row {i}"
+            )
+    dt = _time(run)
+    rec = {
+        "kernel": kind,
+        "bits": bits,
+        "exp_bits": exp_bits,
+        "rows": rows,
+        "seconds": round(dt, 4),
+        "modexp_per_s": round(rows / dt, 1),
+    }
+    print(json.dumps(rec), flush=True)
+    log(f"  {kind:16s} bits={bits} e={exp_bits} rows={rows}: "
+        f"{dt:.3f}s -> {rows / dt:.0f}/s")
+    return rec
+
+
 def main():
     mode = sys.argv[1] if len(sys.argv) > 1 else "quick"
     import jax
@@ -195,6 +242,22 @@ def main():
     except Exception:
         pass
     log(f"devices: {jax.devices()}  backend: {jax.default_backend()}")
+
+    if mode == "sharedexp":
+        # single-kernel micro-step for the armed tunnel-window battery
+        # (ROADMAP item 2 discipline: <= 15 s per point, persisted
+        # per-point via JSON lines before any full bench): the
+        # shared-exponent device kernel at the warm n=16 collect shape
+        # (4096-bit modulus, 2048-bit public exponent, one receiver
+        # group of 16 rows), plus the host engine as the same-shape
+        # reference point.
+        for kind in ("sharedexp-cios", "sharedexp-native"):
+            try:
+                with point_deadline():
+                    measure_shared_exp(kind, 4096, 2048, 16)
+            except Exception as ex:
+                log(f"  {kind}: FAILED {ex}")
+        return
 
     # the collect() shapes that matter: 2048-bit (N~, ring-Pedersen N) and
     # 4096-bit (Paillier N^2) moduli; 256-bit challenges, ~2048-bit secret
@@ -264,6 +327,18 @@ def main():
                     measure_comb(kind, bits, e, g, m)
             except Exception as ex:
                 log(f"  {kind} bits={bits} e={e} G={g} M={m}: FAILED {ex}")
+
+    log("== shared-exponent kernels (FSDKR_RANGEOPT) ==")
+    se_points = (
+        [(4096, 2048, 64)] if mode == "quick" else [(4096, 2048, 240)]
+    )
+    for bits, e, rows in se_points:
+        for kind in ("sharedexp-cios", "sharedexp-native"):
+            try:
+                with point_deadline():
+                    measure_shared_exp(kind, bits, e, rows)
+            except Exception as ex:
+                log(f"  {kind} bits={bits} e={e} rows={rows}: FAILED {ex}")
 
 
 if __name__ == "__main__":

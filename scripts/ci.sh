@@ -47,6 +47,16 @@ FSDKR_RLC=0 python -m pytest tests/test_rlc.py tests/test_tamper.py \
   tests/test_join_tamper.py tests/test_tpu_backend.py -q \
   -m "not slow and not heavy" -p no:cacheprovider
 
+echo "== test: FSDKR_RANGEOPT=0 leg (per-row range column path) =="
+# the smoke tier above ran with the default FSDKR_RANGEOPT=1 (shared-
+# exponent ladders, joint comb apply, concurrent column scheduler); this
+# leg forces the per-row joint/column range path — the fallback the A/B
+# identity depends on — plus FSDKR_MPN=0 so the portable u128 Montgomery
+# core keeps coverage alongside the GMP mpn inner loop
+FSDKR_RANGEOPT=0 FSDKR_MPN=0 python -m pytest tests/test_range_engines.py \
+  tests/test_tamper.py tests/test_tpu_backend.py -q \
+  -m "not slow and not heavy" -p no:cacheprovider
+
 echo "== test: FSDKR_CRT=0 + FSDKR_GMP=0 leg (full-width prover path) =="
 # the smoke tier above ran with the default FSDKR_CRT=1 (secret-CRT
 # prover engine) and the GMP bridge active where present; this leg

@@ -88,6 +88,37 @@ def main():
                 f"| {m.get('mfu', '—')} |"
             )
         print()
+        # verify_pairs sub-phase view (ISSUE 8): the pair-loop wall and
+        # its removal must be visible WITHOUT opening the Chrome trace —
+        # break collect.verify_pairs into its engine sub-phases (the
+        # range.* shared-exponent/comb/z columns, the pdl.* fold columns
+        # and bisection phases) with their share of the family total.
+        pairs_total = tr.get("collect.verify_pairs")
+        if pairs_total:
+            sub = {
+                p: s for p, s in tr.items()
+                if p.startswith(("range.", "pdl.", "pairs."))
+            }
+            if sub:
+                print(
+                    f"#### verify_pairs sub-phases "
+                    f"({pairs_total}s family total)\n"
+                )
+                print("| sub-phase | seconds | % of verify_pairs |")
+                print("|---|---|---|")
+                for p, s in sorted(sub.items(), key=lambda kv: -kv[1]):
+                    pct = round(100.0 * s / pairs_total, 1)
+                    print(f"| {p} | {s} | {pct}% |")
+                accounted = sum(
+                    s for p, s in sub.items()
+                    if not p.startswith("pairs.")  # container span
+                )
+                print(
+                    f"| (glue / unattributed) | "
+                    f"{round(max(0.0, pairs_total - accounted), 3)} | "
+                    f"{round(100.0 * max(0.0, pairs_total - accounted) / pairs_total, 1)}% |"
+                )
+                print()
 
     # unified telemetry blocks (ISSUE 6): newer bench JSONs embed the
     # schema-versioned registry snapshot under "telemetry" — phase

@@ -166,6 +166,20 @@ run n16_norlc 2400 FSDKR_RLC=0 FSDKR_TRACE=1 python bench.py
 # the n16_norlc pattern). The CPU-platform acceptance pair is
 # bench_results/crt_ab_n16_{on,off}.json.
 run n16_nocrt 2400 FSDKR_CRT=0 FSDKR_TRACE=1 python bench.py
+# range-opt A/B (FSDKR_RANGEOPT: =0 reverts the Alice-range family to
+# the per-row joint/column path and verify_pairs to the single fused
+# sequential launch set; =1 is the default — shared-exponent ladders
+# for the s^n mod n^2 column, joint fixed-base comb apply for
+# h1^s1*h2^s2 mod N~, concurrent column scheduler. The nominal n16
+# step above measures the on arm and its trace carries the range.*
+# sub-phases; this is the off arm at the same shape, mirroring the
+# n16_norlc pattern). The CPU-platform acceptance pair is
+# bench_results/rangeopt_ab_n16_{on,off}.json.
+run n16_norangeopt 2400 FSDKR_RANGEOPT=0 FSDKR_TRACE=1 python bench.py
+# single-kernel micro-step for the shared-exponent device kernel
+# (<= 15 s per point, persisted before any full bench — ROADMAP item 2
+# tunnel-window discipline; step 0 smoke + probe cadence as above)
+run sharedexp_kernel 120 python scripts/bench_kernels.py sharedexp
 # precompute offline/online split A/B (FSDKR_PRECOMPUTE: =0 reverts
 # distribute() to the inline path — no pools, no prefill; =1 is the
 # default — the nominal n16 step above measures it and emits

@@ -151,6 +151,24 @@ class TestKernelsLowerForTpu:
         for fn, args, kwargs in calls:
             lower_for_tpu(fn, args, kwargs)
 
+    def test_cios_shared_exp(self):
+        """Shared-exponent rows x limbs kernel (FSDKR_RANGEOPT): the
+        Alice-range s^n column — ONE public exponent's 4-bit digit
+        schedule as a dynamic i32 vector, per-row bases, digit-indexed
+        table select instead of the generic kernel's per-row one-hot
+        compare. Must lower for TPU like the generic CIOS kernel."""
+        mod = secrets.randbits(BITS) | (1 << (BITS - 1)) | 1
+        bases = [secrets.randbelow(mod) for _ in range(8)]
+        exp = secrets.randbits(BITS)
+        calls = []
+        with capture_calls(montgomery, "_shared_exp_kernel", calls):
+            montgomery.shared_exp_modexp(
+                bases, exp, mod, limbs_for_bits(BITS)
+            )
+        assert calls, "driver never reached the shared-exponent kernel"
+        for fn, args, kwargs in calls:
+            lower_for_tpu(fn, args, kwargs)
+
     def test_cios_multi_exp(self):
         """Joint (Straus) multi-exponentiation kernel: the FSDKR_MULTIEXP
         pair-loop rows [s, c^{-1}] with exponents [n, e]."""

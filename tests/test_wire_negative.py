@@ -77,6 +77,37 @@ class TestWireTamper:
                 wire_msgs, keys[0].clone(), dks[0], (), test_config
             )
 
+    def test_multimegabit_s1_rejected_without_dead_row_blowup(
+        self, one_round, test_config
+    ):
+        """A multi-megabit range-proof s1 decodes fine (it is a bare
+        positive hex magnitude) but violates the q^3 slack gate: collect
+        must reject it through the domain gate WITHOUT ever staging the
+        row — in particular without building its (1 + s1*n) % n^2, the
+        round-8 dead-row blowup (backend.tpu_verifier._range_finish /
+        _range_opt_prepare skip gated rows before gs1). The staging-side
+        guarantee is pinned white-box in tests/test_range_engines.py;
+        this is the wire-level end-to-end negative."""
+        keys, msgs, dks = one_round
+        d = json.loads(refresh_message_to_json(msgs[1]))
+        huge = (1 << 2_000_001) + 5  # ~2 Mbit, far past q^3
+        d["range_proofs"][0]["s1"] = format(huge, "x")
+        evil = refresh_message_from_json(json.dumps(d))
+        assert evil.range_proofs[0].s1 == huge
+        wire_msgs = [msgs[0], evil, msgs[2]]
+        from fsdkr_tpu.errors import RangeProofError
+
+        # the batched backend is where dead-row staging lives; the host
+        # oracle short-circuits on the range gate before any arithmetic
+        with pytest.raises(RangeProofError) as ei:
+            RefreshMessage.collect(
+                wire_msgs, keys[0].clone(), dks[0], (),
+                test_config.with_backend("tpu"),
+            )
+        # reference loop attribution: the 0-based receiver slot of the
+        # failing row (src/refresh_message.rs:330-350 loop order)
+        assert ei.value.party_index == 0
+
     @pytest.mark.parametrize(
         "mutate_json",
         [
