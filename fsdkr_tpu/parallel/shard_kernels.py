@@ -23,6 +23,7 @@ from jax.sharding import PartitionSpec as P
 __all__ = [
     "padded_rows",
     "tile_rows_for_mesh",
+    "align_session_batch",
     "shard_map_compat",
     "sharded_modexp_fn",
     "sharded_modmul_fn",
@@ -55,6 +56,23 @@ def padded_rows(rows: int, mesh) -> int:
     """Round `rows` up so it splits evenly across the mesh."""
     n_dev = int(mesh.devices.size)
     return -(-rows // n_dev) * n_dev
+
+
+def align_session_batch(count: int, rows_per_session: int, n_dev: int) -> int:
+    """Largest batch size <= `count` whose total fused-launch row count
+    (batch * rows_per_session) divides evenly across `n_dev` devices —
+    the serving coalescer's mesh-aware sizing (ISSUE 9): a fused
+    finalize launch that does not split evenly falls back to padded
+    rows, wasting device time exactly when the scheduler is trying to
+    keep the mesh full. Returns `count` unchanged when no smaller batch
+    aligns (or on a single device), so coalescing never stalls on an
+    impossible alignment."""
+    if n_dev <= 1 or count <= 0 or rows_per_session <= 0:
+        return count
+    for k in range(count, 0, -1):
+        if (k * rows_per_session) % n_dev == 0:
+            return k
+    return count
 
 
 def tile_rows_for_mesh(tile_rows: int, mesh) -> int:

@@ -19,15 +19,27 @@ from .pools import (  # noqa: F401
     stats_reset,
     take,
 )
+from . import producer  # noqa: F401
 from .producer import (  # noqa: F401
+    KEYS_POOL_OWNER,
     background_enabled,
     clear_targets,
+    committee_owner,
     committee_targets,
+    current_registration_owner,
+    deficit_total,
+    invalidate_owner,
+    invalidate_targets,
     kick,
+    owner_scope,
     prefill,
     produce_for,
     producer_running,
     register_committee,
     register_targets,
+    replace_targets,
+    retarget_committee,
     stop_background,
+    suspend_targets,
+    target_keys,
 )

@@ -188,6 +188,12 @@ class PrecomputeStore:
                 _events().inc(event="dry_fallbacks", kind=kind)
                 return None
             ent = pool.popleft()
+            if not pool:
+                # drop the empty shell: refresh rotates pool keys every
+                # epoch, so drained pools are never refilled under the
+                # same key — keeping the deque would grow the store by
+                # committees x receivers x epochs over a serving run
+                del self._pools[(kind, key)]
             self._bytes -= ent.nbytes
             _events().inc(event="consumed", kind=kind)
             _bytes_gauge().set(self._bytes)

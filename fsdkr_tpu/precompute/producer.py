@@ -22,9 +22,11 @@ measurement and the tests).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import os
 import threading
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from . import pools
 
@@ -40,6 +42,17 @@ __all__ = [
     "stop_background",
     "producer_running",
     "clear_targets",
+    "committee_owner",
+    "KEYS_POOL_OWNER",
+    "owner_scope",
+    "current_registration_owner",
+    "invalidate_owner",
+    "invalidate_targets",
+    "replace_targets",
+    "suspend_targets",
+    "retarget_committee",
+    "target_keys",
+    "deficit_total",
 ]
 
 # production step caps: one background step stays bounded (and stop()
@@ -148,34 +161,104 @@ def produce_for(kind: str, key, count: int) -> int:
 # ---------------------------------------------------------------------------
 # target registry + background thread
 
-# (kind, key) -> (want, generation of the registering call). One
-# register_targets call = one generation; a key not re-registered for
-# _TARGET_TTL_GENS calls is retired together with its pool — refresh
-# rotates every sender's Paillier modulus each epoch, so yesterday's
-# enc/pdl/alice pools can never be consumed again and must not hold
-# secret entries (or byte budget) until process teardown. The TTL is
-# generous enough that several interleaved committees re-registering
-# every epoch each keep their keys alive.
-_TARGETS: Dict[Tuple[str, object], Tuple[int, int]] = {}
+# (kind, key) -> (want, generation, owner, monotonic stamp). One
+# register_targets call = one generation. Retirement (pool wiped with
+# the target — refresh rotates every sender's Paillier modulus each
+# epoch, so a retired key's entries can never be consumed again and
+# must not hold secrets or byte budget until process teardown):
+#
+# - owner=None targets (legacy prefill/bench flows) retire after
+#   _TARGET_TTL_GENS registrations without a refresh — the pre-ISSUE-9
+#   lifecycle, unchanged.
+# - OWNED targets (ISSUE 9 / ROADMAP 5a) have an explicit lifecycle
+#   instead: suspend_targets at epoch start, replace_targets at epoch
+#   handover, invalidate_owner on churn/eviction. They are EXEMPT from
+#   the generation TTL — with hundreds of interleaved committees each
+#   registration ages every other committee, so a generation TTL
+#   retires pools BETWEEN a committee's own epochs (measured in the
+#   serving loadgen: the TTL caused more dry fallbacks than every other
+#   effect combined). A generous wall-clock TTL (_TARGET_TTL_S,
+#   FSDKR_POOL_TTL_S) backstops abandoned owners.
+_TARGETS: Dict[
+    Tuple[str, object], Tuple[int, int, Optional[object], float]
+] = {}
 _TARGETS_LOCK = threading.Lock()
 _TARGET_GEN = 0
 _TARGET_TTL_GENS = 16
 _PRODUCER = None  # lazily built BackgroundProducer
 
 
-def register_targets(targets) -> None:
+def _target_ttl_s() -> float:
+    try:
+        return float(os.environ.get("FSDKR_POOL_TTL_S", "900"))
+    except ValueError:
+        return 900.0
+
+# ambient owner for registrations made inside protocol code (the serving
+# layer wraps distribute in owner_scope(committee_id) so the auto-
+# registration at the end of distribute_batch lands under the serving
+# committee identity — clones sharing a mod-N~ fingerprint stay distinct)
+_REG_OWNER: contextvars.ContextVar = contextvars.ContextVar(
+    "fsdkr_precompute_owner", default=None
+)
+
+
+# owner of every ("keys", ...) target: the key-material pool is keyed by
+# config parameters alone, so it is SHARED by every committee with that
+# config — it must never be claimed by (or invalidated with) any single
+# committee's owner tag, or one committee's churn would wipe the fleet's
+# pooled key bundles
+KEYS_POOL_OWNER = ("keys-pool",)
+
+
+def committee_owner(dlog_statements) -> tuple:
+    """Stable committee fingerprint for target ownership: the tuple of
+    the committee's mod-N~ moduli in slot order. The environments are
+    stable across refreshes (only churn changes them), public, and
+    unique per real committee — exactly the lifetime pool targets share."""
+    return ("committee-ntilde",) + tuple(d.N for d in dlog_statements)
+
+
+@contextlib.contextmanager
+def owner_scope(owner):
+    """Ambient registration owner for the duration of the block: every
+    register_targets call without an explicit owner (notably the
+    auto-registration at the end of distribute_batch) is tagged with
+    `owner`. Thread-local (contextvar), so concurrent serving workers
+    tag their own committees."""
+    tok = _REG_OWNER.set(owner)
+    try:
+        yield
+    finally:
+        _REG_OWNER.reset(tok)
+
+
+def current_registration_owner():
+    return _REG_OWNER.get()
+
+
+def register_targets(targets, owner=None) -> None:
     """Record desired pool depths: targets = [(kind, key, want)] —
-    re-registering refreshes a key's generation and want; keys not
-    re-registered for _TARGET_TTL_GENS calls are retired and their
-    pools wiped. clear_targets() forgets everything at once."""
+    re-registering refreshes a key's generation, want, and owner.
+    Retirement sweep (see the _TARGETS comment): owner-less keys not
+    re-registered for _TARGET_TTL_GENS calls, plus any key older than
+    the wall-clock backstop, are dropped and their pools wiped.
+    clear_targets() forgets everything at once."""
     global _TARGET_GEN
+    import time
+
+    if owner is None:
+        owner = _REG_OWNER.get()
+    now = time.monotonic()
+    ttl_s = _target_ttl_s()
     stale = []
     with _TARGETS_LOCK:
         _TARGET_GEN += 1
         for kind, key, want in targets:
-            _TARGETS[(kind, key)] = (int(want), _TARGET_GEN)
-        for k, (_want, gen) in list(_TARGETS.items()):
-            if gen <= _TARGET_GEN - _TARGET_TTL_GENS:
+            _TARGETS[(kind, key)] = (int(want), _TARGET_GEN, owner, now)
+        for k, (_want, gen, o, stamp) in list(_TARGETS.items()):
+            gen_stale = o is None and gen <= _TARGET_GEN - _TARGET_TTL_GENS
+            if gen_stale or now - stamp > ttl_s:
                 del _TARGETS[k]
                 stale.append(k)
     store = pools.get_store()
@@ -183,10 +266,127 @@ def register_targets(targets) -> None:
         store.drop(kind, key)
 
 
+def target_keys(owner=None) -> List[Tuple[str, object]]:
+    """Currently registered (kind, key) targets, optionally filtered to
+    one owner (introspection for tests and the capacity planner)."""
+    with _TARGETS_LOCK:
+        return [
+            k
+            for k, (_w, _g, o, _t) in _TARGETS.items()
+            if owner is None or o == owner
+        ]
+
+
+def invalidate_targets(keys) -> int:
+    """Drop the given (kind, key) targets and WIPE their pools — every
+    unconsumed single-use entry keyed by them is destroyed now, not when
+    the TTL fires. Returns the number of targets dropped."""
+    keys = list(keys)
+    dropped = []
+    with _TARGETS_LOCK:
+        for k in keys:
+            if k in _TARGETS:
+                del _TARGETS[k]
+                dropped.append(k)
+    store = pools.get_store()
+    # wipe pools for every requested key, registered or not: produce_for
+    # can fill a pool without a live target (prefill races, direct use)
+    for kind, key in keys:
+        store.drop(kind, key)
+    return len(dropped)
+
+
+def invalidate_owner(owner) -> int:
+    """Drop every target registered under `owner` and wipe its pools —
+    the churn entry point (join/replace/remove re-keys the committee, so
+    the old owner's pooled secrets can never be consumed again). Returns
+    the number of targets dropped."""
+    if owner is None:
+        return 0
+    with _TARGETS_LOCK:
+        keys = [k for k, (_w, _g, o, _t) in _TARGETS.items() if o == owner]
+        for k in keys:
+            del _TARGETS[k]
+    store = pools.get_store()
+    for kind, key in keys:
+        store.drop(kind, key)
+    return len(keys)
+
+
+def suspend_targets(owner) -> int:
+    """Unregister `owner`'s targets WITHOUT wiping their pools — called
+    at the start of an epoch's distribute, which is about to consume
+    those pools. While a target is live the producer cannot distinguish
+    "empty because not yet filled" from "empty because the epoch just
+    drained it", so mid-epoch kicks (another committee's collect) made
+    it refill pools whose keys were minutes from rotation — production
+    that the end-of-epoch replace_targets then wiped. Suspending for
+    the epoch's duration closes that window; the end of distribute
+    re-registers the next epoch's targets. Returns targets removed."""
+    if owner is None:
+        return 0
+    with _TARGETS_LOCK:
+        keys = [k for k, (_w, _g, o, _t) in _TARGETS.items() if o == owner]
+        for k in keys:
+            del _TARGETS[k]
+    return len(keys)
+
+
+def replace_targets(targets, owner) -> None:
+    """register_targets PLUS wipe-on-invalidate for `owner`: any target
+    currently registered under `owner` but absent from `targets` is
+    dropped and its pool wiped. This is how an epoch hands over — the
+    end of distribute_batch replaces the committee's per-receiver
+    targets with next-epoch keys, so the producer never refills pools
+    the epoch just drained (measured: the additive registration made
+    the producer refill-then-wipe ~1 entry for every entry served)."""
+    fresh_keys = {(kind, key) for kind, key, _want in targets}
+    with _TARGETS_LOCK:
+        stale = [
+            k
+            for k, (_w, _g, o, _t) in _TARGETS.items()
+            if o == owner and k not in fresh_keys
+        ]
+        for k in stale:
+            del _TARGETS[k]
+    store = pools.get_store()
+    for kind, key in stale:
+        store.drop(kind, key)
+    register_targets(targets, owner=owner)
+
+
+def retarget_committee(
+    local_key, new_n: int, senders: int, config, owner, keys_want=None
+) -> None:
+    """Atomic churn-safe retarget: wipe everything registered under
+    `owner` that the committee's CURRENT layout no longer wants, then
+    register the fresh target set under the same owner. The capacity
+    planner calls this after every completed epoch (the committee's
+    paillier_key_vec just rotated) and after churn.
+
+    Depth economics: `senders` sizes the per-receiver enc/pdl/alice
+    pools, whose keys rotate EVERY epoch — depth beyond one epoch of
+    consumption is guaranteed wipe-waste, so callers pass one epoch's
+    demand. The config-keyed "keys" pool is the opposite: shared across
+    committees and epoch-stable, so it is registered under
+    KEYS_POOL_OWNER (never this committee's owner) with `keys_want`
+    (default: the committee's own epoch demand; the planner passes the
+    fleet-wide figure)."""
+    fresh = committee_targets(local_key, new_n, senders, config)
+    keys_target = fresh.pop()  # ("keys", pool_key, senders) — documented last
+    replace_targets(fresh, owner=owner)
+    register_targets(
+        [(keys_target[0], keys_target[1], keys_want or keys_target[2])],
+        owner=KEYS_POOL_OWNER,
+    )
+
+
 def committee_targets(local_key, new_n: int, senders: int, config) -> list:
     """Target list for one committee: `senders` entries per receiver
     pool (every sender consumes one entry per receiver per epoch) and
-    `senders` key bundles — one epoch ahead of steady-state demand."""
+    `senders` key bundles — one epoch ahead of steady-state demand.
+    The ("keys", ...) target is always LAST (retarget_committee and the
+    serving planner split it off for shared fleet ownership)."""
     out = []
     for i in range(new_n):
         ek = local_key.paillier_key_vec[i]
@@ -199,8 +399,10 @@ def committee_targets(local_key, new_n: int, senders: int, config) -> list:
     return out
 
 
-def register_committee(local_key, new_n: int, senders: int, config) -> None:
-    register_targets(committee_targets(local_key, new_n, senders, config))
+def register_committee(local_key, new_n: int, senders: int, config, owner=None) -> None:
+    register_targets(
+        committee_targets(local_key, new_n, senders, config), owner=owner
+    )
 
 
 def clear_targets() -> None:
@@ -213,11 +415,18 @@ def _deficits() -> List[Tuple[str, object, int]]:
     with _TARGETS_LOCK:
         items = list(_TARGETS.items())
     out = []
-    for (kind, key), (want, _gen) in items:
+    for (kind, key), (want, _gen, _owner, _stamp) in items:
         room = store.room(kind, key, want)
         if room > 0:
             out.append((kind, key, room))
     return out
+
+
+def deficit_total() -> int:
+    """Entries still missing across every registered target (0 = every
+    pool at depth) — the prefill-progress probe the serving load
+    generator polls while the background producer fills."""
+    return sum(room for _kind, _key, room in _deficits())
 
 
 def _step() -> bool:

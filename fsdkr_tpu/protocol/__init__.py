@@ -5,6 +5,7 @@ the GG20-compatible host application surface the reference borrows from
 
 from .local_key import LocalKey, SharedKeys, PaillierKeyPair
 from .refresh import RefreshMessage
+from .streaming import StreamingCollect, finalize_streams
 from .join import JoinMessage
 from .keygen import simulate_keygen, generate_h1_h2_n_tilde, generate_dlog_statement_proofs
 from .signing import simulate_offline_stage, simulate_signing, ecdsa_verify
@@ -15,6 +16,8 @@ __all__ = [
     "SharedKeys",
     "PaillierKeyPair",
     "RefreshMessage",
+    "StreamingCollect",
+    "finalize_streams",
     "JoinMessage",
     "simulate_keygen",
     "generate_h1_h2_n_tilde",

@@ -133,6 +133,17 @@ class RefreshMessage:
         # produced values (bit-identical to inline sampling+compute),
         # dry rows fall back to the inline columns of that same phase
         pre_on = precompute.enabled()
+        if pre_on:
+            # this epoch is about to drain its pools: suspend the
+            # committee's targets so a mid-epoch producer kick cannot
+            # refill pools whose keys rotate at the end of this call
+            # (re-registered for the next epoch below)
+            owner = precompute.current_registration_owner()
+            if owner is None:
+                owner = precompute.committee_owner(
+                    senders[0][1].h1_h2_n_tilde_vec[:new_n]
+                )
+            precompute.suspend_targets(owner)
 
         # validate every sender BEFORE the first mutation: a late failure
         # must not leave earlier senders' vss_scheme replaced by schemes
@@ -449,8 +460,20 @@ class RefreshMessage:
                     ("pdl", env, len(per)),
                     ("alice", env, len(per)),
                 ]
-            targets.append(("keys", config.key_material_pool_key, len(per)))
-            precompute.register_targets(targets)
+            # owner tag (ISSUE 9 / ROADMAP 5a): the per-receiver targets
+            # belong to THIS committee (`owner` from the top of this
+            # call: the serving layer's explicit scope, or the stable
+            # mod-N~ environment fingerprint) — so a churn (join/replace/
+            # remove) can invalidate them explicitly instead of leaving
+            # stale-keyed secret pools to age out. REPLACE semantics wipe
+            # whatever the drained epoch left behind; the config-keyed
+            # key-material pool is shared by every committee and
+            # registered under the fleet owner instead.
+            precompute.replace_targets(targets, owner=owner)
+            precompute.register_targets(
+                [("keys", config.key_material_pool_key, len(per))],
+                owner=precompute.producer.KEYS_POOL_OWNER,
+            )
             precompute.kick()
         return out
 
@@ -548,6 +571,18 @@ class RefreshMessage:
     ) -> Tuple["RefreshMessage", DecryptionKey]:
         """State surgery for index remapping + joins, then an ordinary
         distribute (reference :239-319)."""
+        # churn invalidation (ROADMAP 5a): the pools registered at the end
+        # of the last epoch's distribute are keyed by the pre-churn
+        # committee layout (receiver moduli + mod-N~ environments); the
+        # surgery below changes that layout, so those entries can never be
+        # consumed again — wipe them NOW instead of letting single-use
+        # secrets age out through the target TTL
+        from .. import precompute
+
+        if precompute.enabled():
+            precompute.invalidate_owner(
+                precompute.committee_owner(key.h1_h2_n_tilde_vec)
+            )
         size = max(new_n, len(key.paillier_key_vec))
         new_ek_vec: List[Optional[EncryptionKey]] = [None] * size
         new_dlog_vec: List[Optional[DLogStatement]] = [None] * size
@@ -595,6 +630,28 @@ class RefreshMessage:
         )[0]
         if err is not None:
             raise err
+
+    @staticmethod
+    def collect_stream(
+        local_key: LocalKey,
+        new_dk: DecryptionKey,
+        expected_senders: Optional[Sequence[int]] = None,
+        join_messages: Sequence["JoinMessage"] = (),
+        config: ProtocolConfig = DEFAULT_CONFIG,
+    ) -> "StreamingCollect":
+        """Streaming counterpart of `collect` (ISSUE 9): returns a
+        StreamingCollect session that verifies broadcast messages
+        incrementally as they are `offer`ed — cheap structural checks and
+        the per-message proof families eagerly, the pair-family RLC fold
+        at quorum (`finalize()`). Verdicts, identifiable-abort blame, and
+        LocalKey mutation are bit-identical to barrier `collect` on the
+        same message set in `expected_senders` order (default: this
+        committee's party indices 1..n). See protocol.streaming."""
+        from .streaming import StreamingCollect
+
+        return StreamingCollect(
+            local_key, new_dk, expected_senders, join_messages, config
+        )
 
     @staticmethod
     def collect_sessions(
@@ -647,31 +704,13 @@ class RefreshMessage:
             return [s for s in range(S) if errors[s] is None]
 
         def fused_multi(call, lists, spans):
-            """Run one fused backend launch over parallel item lists (all
-            sharing the same session spans); if a malformed session makes
-            the whole batch raise (e.g. a crafted proof field the batch
-            codec rejects), isolate per session so the bad session gets
-            the error and the others still verify — the "a failing
-            session never blocks the others" guarantee. Returns one
-            verdict list per input list."""
-            try:
-                return call(*lists)
-            except Exception:
-                outs = tuple([None] * len(lst) for lst in lists)
-                for s, (lo, hi) in spans.items():
-                    if errors[s] is not None:
-                        continue
-                    try:
-                        res = call(*(lst[lo:hi] for lst in lists))
-                        for out, part in zip(outs, res):
-                            out[lo:hi] = part
-                    except Exception as e:
-                        errors[s] = e  # rows stay None; phases skip s
-                return outs
+            return fused_isolated(call, lists, spans, errors)
 
         def fused(call, items, spans):
-            """Single-list fused_multi."""
-            return fused_multi(lambda lst: (call(lst),), (items,), spans)[0]
+            """Single-list fused_isolated."""
+            return fused_isolated(
+                lambda lst: (call(lst),), (items,), spans, errors
+            )[0]
 
         # ---- structure checks + fused Feldman validation --------------
         # (validate_collect semantics, reference :147-191)
@@ -681,25 +720,7 @@ class RefreshMessage:
             new_n = len(msgs) + len(joins)
             new_ns[s] = new_n
             try:
-                if len(msgs) <= key.t:
-                    raise PartiesThresholdViolation(
-                        threshold=key.t, refreshed_keys=len(msgs)
-                    )
-                for k, msg in enumerate(msgs):
-                    lens = (
-                        len(msg.pdl_proof_vec),
-                        len(msg.points_committed_vec),
-                        len(msg.points_encrypted_vec),
-                    )
-                    if any(l != new_n for l in lens) or len(msg.range_proofs) != new_n:
-                        raise SizeMismatchError(k, *lens)
-                    # the reference gates broadcast public_key only on the
-                    # join path (add_party_message.rs:268-274, quirk 5);
-                    # here an existing party knows the true group key, so
-                    # gate every broadcast against it — an inconsistent
-                    # sender is caught by verifiers too, not just joiners
-                    if msg.public_key != key.y_sum_s:
-                        raise BroadcastedPublicKeyError(msg.party_index)
+                check_structure(msgs, key, new_n)
             except Exception as e:
                 errors[s] = e
                 continue
@@ -757,15 +778,10 @@ class RefreshMessage:
                 if errors[s] is not None:
                     continue
                 msgs, _key, _dk, _joins = sessions[s]
-                row = start
                 try:
-                    for msg in msgs:
-                        for i in range(new_ns[s]):
-                            if pdl_verdicts[row] is not None:
-                                raise PDLwSlackProofError(*pdl_verdicts[row])
-                            if not range_verdicts[row]:
-                                raise RangeProofError(party_index=i)
-                            row += 1
+                    pair_blame(
+                        msgs, new_ns[s], pdl_verdicts, range_verdicts, start
+                    )
                 except Exception as e:
                     errors[s] = e
 
@@ -797,21 +813,7 @@ class RefreshMessage:
             for s in alive():
                 msgs, key, _dk, _joins = sessions[s]
                 try:
-                    old_ek = key.paillier_key_vec[key.i - 1]
-                    cipher_sum, li_vec = RefreshMessage.get_ciphertext_sum(
-                        msgs, key.i, key.vss_scheme.parameters, old_ek
-                    )
-                    # Hardening absent from the reference: the Lagrange
-                    # weights must re-derive the unchanged group key, or a
-                    # lying/duplicated old_party_index silently rotates the
-                    # committee onto a DIFFERENT secret (see
-                    # interpolate_constant_term).
-                    y_check = RefreshMessage.interpolate_constant_term(
-                        msgs, li_vec, key.t
-                    )
-                    if y_check != key.y_sum_s:
-                        raise PublicShareValidationError()
-                    sums[s] = (old_ek, cipher_sum, li_vec)
+                    sums[s] = share_recovery_check(msgs, key)
                 except Exception as e:
                     errors[s] = e
 
@@ -862,63 +864,182 @@ class RefreshMessage:
         with phase("collect.adopt", items=len(alive())):
             for s in alive():
                 msgs, local_key, new_dk, joins = sessions[s]
-                new_n = new_ns[s]
-                ck0, d0 = ck_spans[s][0], dlog_spans[s][0]
+                ck0, ck1 = ck_spans[s]
+                d0, d1 = dlog_spans[s]
                 try:
-                    for k, msg in enumerate(msgs):
-                        if not ck_verdicts[ck0 + k]:
-                            raise PaillierVerificationError(party_index=msg.party_index)
-                        n_len = msg.ek.n.bit_length()
-                        if n_len > config.paillier_bits or n_len < config.paillier_bits - 1:
-                            raise ModuliTooSmall(
-                                party_index=msg.party_index, moduli_size=n_len
-                            )
-                        local_key.paillier_key_vec[msg.party_index - 1] = msg.ek
-
-                    for k, join in enumerate(joins):
-                        party_index = join.get_party_index()
-                        if not ck_verdicts[ck0 + len(msgs) + k]:
-                            raise PaillierVerificationError(party_index=party_index)
-                        if not (dlog_verdicts[d0 + 2 * k] and dlog_verdicts[d0 + 2 * k + 1]):
-                            raise DLogProofValidation(party_index=party_index)
-                        n_len = join.ek.n.bit_length()
-                        if n_len > config.paillier_bits or n_len < config.paillier_bits - 1:
-                            raise ModuliTooSmall(
-                                party_index=party_index, moduli_size=n_len
-                            )
-                        local_key.paillier_key_vec[party_index - 1] = join.ek
-
-                    # ---- decrypt own new share; rotate key material -------
-                    old_ek, cipher_sum, li_vec = sums[s]
-                    new_share = paillier.decrypt(
-                        local_key.paillier_dk, old_ek, cipher_sum
+                    adopt_session(
+                        msgs, local_key, new_dk, joins,
+                        ck_verdicts[ck0:ck1], dlog_verdicts[d0:d1],
+                        sums[s], new_ns[s], config,
                     )
-                    new_share_fe = Scalar.from_int(new_share)
-
-                    # pk_vec rebuild by assignment — conscious fix of quirk 1
-                    # (reference :455-464 uses Vec::insert)
-                    pk_vec = combine_committed_points(
-                        msgs, li_vec, local_key.t, new_n,
-                        use_device=config.device_ec,
-                    )
-
-                    # consistency gate absent from the reference: the decrypted
-                    # share must match the Feldman-committed public share, or
-                    # the key would be silently corrupted (e.g. by a plaintext
-                    # wrap mod a too-small Paillier modulus)
-                    if GENERATOR * new_share_fe != pk_vec[local_key.i - 1]:
-                        raise PublicShareValidationError()
-
-                    # zeroize the old dk, install the new one (reference :445-448)
-                    local_key.paillier_dk.zeroize()
-                    local_key.paillier_dk = new_dk
-
-                    local_key.keys_linear.x_i = new_share_fe
-                    local_key.keys_linear.y = GENERATOR * new_share_fe
-                    local_key.pk_vec = pk_vec
                 except Exception as e:
                     errors[s] = e
         return errors
+
+
+def fused_isolated(call, lists, spans, errors):
+    """Run one fused backend launch over parallel item lists (all
+    sharing the same session spans); if a malformed session makes the
+    whole batch raise (e.g. a crafted proof field the batch codec
+    rejects), isolate per session so the bad session gets the error and
+    the others still verify — the "a failing session never blocks the
+    others" guarantee. `errors` is the per-session error slate (an entry
+    set here makes later phases skip that session). Returns one verdict
+    list per input list. Shared by the barrier (_collect_sessions_impl)
+    and streaming (protocol.streaming.finalize_streams) paths."""
+    try:
+        return call(*lists)
+    except Exception:
+        outs = tuple([None] * len(lst) for lst in lists)
+        for s, (lo, hi) in spans.items():
+            if errors[s] is not None:
+                continue
+            try:
+                res = call(*(lst[lo:hi] for lst in lists))
+                for out, part in zip(outs, res):
+                    out[lo:hi] = part
+            except Exception as e:
+                errors[s] = e  # rows stay None; phases skip s
+        return outs
+
+
+# ---------------------------------------------------------------------------
+# Per-session collect stages, shared by the barrier path
+# (_collect_sessions_impl) and the streaming path (protocol.streaming).
+# Keeping check order, error construction, and mutation points in ONE set
+# of functions is what makes streaming-vs-barrier verdict and
+# identifiable-abort blame identity a structural property instead of a
+# test-pinned coincidence (ISSUE 9 acceptance).
+
+
+def check_structure(msgs: Sequence["RefreshMessage"], key: LocalKey, new_n: int) -> None:
+    """Threshold + per-message wire-shape + broadcast-public-key gates
+    (reference :147-191 plus the quirk-5 generalization), first error in
+    message order."""
+    if len(msgs) <= key.t:
+        raise PartiesThresholdViolation(
+            threshold=key.t, refreshed_keys=len(msgs)
+        )
+    for k, msg in enumerate(msgs):
+        lens = (
+            len(msg.pdl_proof_vec),
+            len(msg.points_committed_vec),
+            len(msg.points_encrypted_vec),
+        )
+        if any(l != new_n for l in lens) or len(msg.range_proofs) != new_n:
+            raise SizeMismatchError(k, *lens)
+        # the reference gates broadcast public_key only on the join path
+        # (add_party_message.rs:268-274, quirk 5); here an existing party
+        # knows the true group key, so gate every broadcast against it —
+        # an inconsistent sender is caught by verifiers too, not just
+        # joiners
+        if msg.public_key != key.y_sum_s:
+            raise BroadcastedPublicKeyError(msg.party_index)
+
+
+def pair_blame(
+    msgs: Sequence["RefreshMessage"],
+    new_n: int,
+    pdl_verdicts: Sequence,
+    range_verdicts: Sequence,
+    start: int = 0,
+) -> None:
+    """Attribute pair-loop failures in the reference's loop order (msg
+    outer, i inner; PDL before range — src/refresh_message.rs:330-350).
+    `start` is this session's first row in the fused verdict arrays."""
+    row = start
+    for msg in msgs:
+        for i in range(new_n):
+            if pdl_verdicts[row] is not None:
+                raise PDLwSlackProofError(*pdl_verdicts[row])
+            if not range_verdicts[row]:
+                raise RangeProofError(party_index=i)
+            row += 1
+
+
+def share_recovery_check(
+    msgs: Sequence["RefreshMessage"], key: LocalKey
+) -> Tuple[EncryptionKey, int, List[Scalar]]:
+    """Homomorphic share-recovery inputs + the constant-term Lagrange
+    gate (reference :367-373 plus the quirk-4 hardening): the Lagrange
+    weights must re-derive the unchanged group key, or a lying/
+    duplicated old_party_index silently rotates the committee onto a
+    DIFFERENT secret (see interpolate_constant_term)."""
+    old_ek = key.paillier_key_vec[key.i - 1]
+    cipher_sum, li_vec = RefreshMessage.get_ciphertext_sum(
+        msgs, key.i, key.vss_scheme.parameters, old_ek
+    )
+    y_check = RefreshMessage.interpolate_constant_term(msgs, li_vec, key.t)
+    if y_check != key.y_sum_s:
+        raise PublicShareValidationError()
+    return old_ek, cipher_sum, li_vec
+
+
+def adopt_session(
+    msgs: Sequence["RefreshMessage"],
+    local_key: LocalKey,
+    new_dk: DecryptionKey,
+    joins: Sequence["JoinMessage"],
+    ck_verdicts: Sequence[bool],
+    dlog_verdicts: Sequence[bool],
+    recovered: Tuple[EncryptionKey, int, List[Scalar]],
+    new_n: int,
+    config: ProtocolConfig,
+) -> None:
+    """The mutating adoption phase of one session (reference :375-467):
+    correct-key/dlog verdict gates, moduli-size gates, paillier_key_vec
+    installs, own-share decrypt + Feldman consistency gate, key rotation.
+    `ck_verdicts` covers msgs then joins; `dlog_verdicts` two per join.
+    A failure mid-way leaves the same partial paillier_key_vec updates
+    the reference would."""
+    for k, msg in enumerate(msgs):
+        if not ck_verdicts[k]:
+            raise PaillierVerificationError(party_index=msg.party_index)
+        n_len = msg.ek.n.bit_length()
+        if n_len > config.paillier_bits or n_len < config.paillier_bits - 1:
+            raise ModuliTooSmall(
+                party_index=msg.party_index, moduli_size=n_len
+            )
+        local_key.paillier_key_vec[msg.party_index - 1] = msg.ek
+
+    for k, join in enumerate(joins):
+        party_index = join.get_party_index()
+        if not ck_verdicts[len(msgs) + k]:
+            raise PaillierVerificationError(party_index=party_index)
+        if not (dlog_verdicts[2 * k] and dlog_verdicts[2 * k + 1]):
+            raise DLogProofValidation(party_index=party_index)
+        n_len = join.ek.n.bit_length()
+        if n_len > config.paillier_bits or n_len < config.paillier_bits - 1:
+            raise ModuliTooSmall(
+                party_index=party_index, moduli_size=n_len
+            )
+        local_key.paillier_key_vec[party_index - 1] = join.ek
+
+    # ---- decrypt own new share; rotate key material -------------------
+    old_ek, cipher_sum, li_vec = recovered
+    new_share = paillier.decrypt(local_key.paillier_dk, old_ek, cipher_sum)
+    new_share_fe = Scalar.from_int(new_share)
+
+    # pk_vec rebuild by assignment — conscious fix of quirk 1
+    # (reference :455-464 uses Vec::insert)
+    pk_vec = combine_committed_points(
+        msgs, li_vec, local_key.t, new_n, use_device=config.device_ec,
+    )
+
+    # consistency gate absent from the reference: the decrypted share
+    # must match the Feldman-committed public share, or the key would be
+    # silently corrupted (e.g. by a plaintext wrap mod a too-small
+    # Paillier modulus)
+    if GENERATOR * new_share_fe != pk_vec[local_key.i - 1]:
+        raise PublicShareValidationError()
+
+    # zeroize the old dk, install the new one (reference :445-448)
+    local_key.paillier_dk.zeroize()
+    local_key.paillier_dk = new_dk
+
+    local_key.keys_linear.x_i = new_share_fe
+    local_key.keys_linear.y = GENERATOR * new_share_fe
+    local_key.pk_vec = pk_vec
 
 
 def combine_committed_points(

@@ -1,0 +1,31 @@
+"""Refresh-as-a-service (ISSUE 9): the streaming multi-committee serving
+loop — RefreshService scheduler (admission, per-session lifecycle,
+coalesced fused finalize launches), SLO-driven capacity planning for the
+precompute pools, batching policy, and the `fsdkr_serving_*` telemetry.
+
+Layering rule (enforced by scripts/lint_imports.py): this package
+orchestrates through `protocol`, `precompute`, `parallel.shard_kernels`,
+`telemetry`, and `utils` only — never `proofs`, `backend`, `ops`,
+`native`, or `core` internals. The cryptography stays behind the
+protocol surface; serving is scheduling.
+
+Gate: FSDKR_SERVE (default on). Fully off, `RefreshService.submit` runs
+each session synchronously through the unchanged single-shot barrier
+API (`distribute_batch` + `collect_sessions`).
+"""
+
+from .planner import SLO, CapacityPlanner, serve_owner  # noqa: F401
+from .policy import BatchPolicy  # noqa: F401
+from .service import RefreshService, ServeSession, enabled  # noqa: F401
+from . import metrics  # noqa: F401
+
+__all__ = [
+    "SLO",
+    "CapacityPlanner",
+    "serve_owner",
+    "BatchPolicy",
+    "RefreshService",
+    "ServeSession",
+    "enabled",
+    "metrics",
+]

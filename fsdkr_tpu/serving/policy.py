@@ -1,0 +1,78 @@
+"""Batching policy for the coalescing finalize launcher (ISSUE 9).
+
+Quorum-ready streaming sessions are fused into one `finalize_streams`
+launch; the policy decides WHEN to launch and HOW MANY sessions to take.
+Classic size-or-linger batching: launch immediately once
+`FSDKR_SERVE_BATCH` sessions are ready, otherwise wait up to
+`FSDKR_SERVE_LINGER_MS` from the oldest ready session before launching
+whatever is there — throughput from fusion without unbounded latency
+(the SZKP-style producer/consumer decoupling needs the consumer launch
+to stay full, but a p99 budget caps how long a session may sit waiting
+for company).
+
+Mesh awareness: on a real device mesh the fused pair launch row-shards
+over all devices, so the policy prefers batch sizes whose total row
+count divides the mesh (`parallel.shard_kernels.align_session_batch`);
+on the host path (device count 1) alignment is a no-op.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["BatchPolicy"]
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+class BatchPolicy:
+    """Size-or-linger coalescing. `max_sessions` counts collector
+    streams (one committee refresh with n collecting parties contributes
+    n of them)."""
+
+    def __init__(
+        self,
+        max_sessions: int = 0,
+        linger_s: float = -1.0,
+        devices: int = 1,
+    ):
+        self.max_sessions = max_sessions or _env_int("FSDKR_SERVE_BATCH", 16)
+        self.linger_s = (
+            linger_s
+            if linger_s >= 0
+            else _env_float("FSDKR_SERVE_LINGER_MS", 50.0) / 1000.0
+        )
+        self.devices = max(1, devices)
+
+    def take(
+        self, ready: int, oldest_wait_s: float, rows_per_session: int = 0
+    ) -> int:
+        """How many ready sessions to fuse into a launch right now;
+        0 = keep lingering. Never returns more than `ready`."""
+        if ready <= 0:
+            return 0
+        if ready < self.max_sessions and oldest_wait_s < self.linger_s:
+            return 0
+        count = min(ready, self.max_sessions)
+        if self.devices > 1 and rows_per_session > 0:
+            from ..parallel.shard_kernels import align_session_batch
+
+            count = align_session_batch(count, rows_per_session, self.devices)
+        return count
+
+    def wait_budget(self, oldest_wait_s: float) -> float:
+        """Seconds the launcher may sleep before the linger deadline of
+        the oldest ready session expires."""
+        return max(0.0, self.linger_s - oldest_wait_s)

@@ -1,0 +1,506 @@
+"""RefreshService: the long-running multi-committee serving loop
+(ISSUE 9, ROADMAP open item 1).
+
+fs-dkr's refresh is ONE broadcast round, so served throughput is a
+scheduling problem: keep the verify/prove engines saturated while many
+committees cycle through admit -> distribute -> collect. This service
+composes the pieces the engine rounds built — `distribute_batch`'s fused
+prover columns, the precompute pools + background producer, streaming
+collect (`protocol.streaming`), and the fused quorum-time finalize — into
+a scheduler:
+
+- `admit(committee_id, keys, ...)` registers a committee and hands its
+  SLO to the CapacityPlanner (pool depth targets under the committee's
+  serving owner tag).
+- `submit(committee_id)` enqueues one refresh session. The admission
+  queue holds PUBLIC metadata only (ids, timestamps); key material stays
+  in the per-committee table and is touched only by the protocol calls.
+- Worker threads run the prover side (`distribute_batch` under the
+  committee's precompute owner scope) and feed the broadcast messages
+  into per-party `StreamingCollect` sessions — eager per-message
+  verification happens here, spread over the arrival window.
+- A launcher thread coalesces quorum-ready sessions into fused
+  `finalize_streams` launches sized by the BatchPolicy (size-or-linger,
+  mesh-aware), then rotates committee state and retargets the planner
+  (the eks just rotated, so the pool targets must follow).
+
+Lifecycle per session: admitted -> pooled (queued) -> distributing ->
+collecting -> finalizing -> done | aborted, each transition stamped and
+exported through the `fsdkr_serving_*` metrics (serving.metrics).
+
+`FSDKR_SERVE=0` turns the scheduler off: `submit` runs the session
+synchronously through today's single-shot barrier API
+(`distribute_batch` + `collect_sessions`) with no streaming, batching,
+or service threads — the A/B arm pinning that the serving layer adds
+scheduling, not semantics.
+
+Concurrency rules: at most one in-flight session per committee (a
+refresh mutates the committee's LocalKeys; sessions for one committee
+serialize through the busy flag while other committees proceed), and
+`offer`/`finalize` for one streaming session never race (offers happen
+on the worker before the session is published to the ready list; the
+launcher finalizes only published sessions).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from .. import precompute
+from ..config import ProtocolConfig, DEFAULT_CONFIG
+from ..protocol.refresh import RefreshMessage
+from ..protocol.streaming import finalize_streams
+from . import metrics
+from .planner import SLO, CapacityPlanner, serve_owner
+from .policy import BatchPolicy
+
+__all__ = ["RefreshService", "ServeSession", "enabled"]
+
+
+def enabled() -> bool:
+    """FSDKR_SERVE gates the scheduler (default on). =0 makes submit()
+    a synchronous single-shot barrier refresh — today's API, unchanged."""
+    return os.environ.get("FSDKR_SERVE", "1").lower() not in (
+        "0", "off", "false", "no",
+    )
+
+
+def _device_count() -> int:
+    """Device count for the default BatchPolicy's mesh-aware batch
+    alignment; 1 (alignment off) when JAX is unavailable or still
+    uninitialized-fast-path. The fused finalize launches row-shard over
+    all local devices, so the coalescer sizes batches to divide them."""
+    try:
+        import jax
+
+        return max(1, jax.local_device_count())
+    except Exception:
+        return 1
+
+
+def _shuffle_arrivals() -> bool:
+    """FSDKR_SERVE_SHUFFLE (default on): feed each session's broadcast
+    messages to the streaming collectors in a session-seeded random
+    order, exercising the arrival-order independence the equivalence
+    tests pin. =0 feeds canonical order (debugging)."""
+    return os.environ.get("FSDKR_SERVE_SHUFFLE", "1").lower() not in (
+        "0", "off", "false", "no",
+    )
+
+
+@dataclass
+class ServeSession:
+    """Public per-session record. Queue/state fields are broadcast-safe
+    metadata; the streaming collectors (which hold broadcast messages
+    and verdicts) hang off the internal `_streams` and never enter the
+    admission queue."""
+
+    session_id: int
+    committee_id: object
+    state: str = "admitted"
+    submitted_at: float = 0.0
+    started_at: float = 0.0
+    quorum_at: float = 0.0
+    finalized_at: float = 0.0
+    error: Optional[str] = None
+    _streams: list = field(default_factory=list, repr=False)
+    _config: Optional[ProtocolConfig] = field(default=None, repr=False)
+    _done_evt: threading.Event = field(
+        default_factory=threading.Event, repr=False
+    )
+
+
+@dataclass
+class _Committee:
+    keys: list
+    config: ProtocolConfig
+    slo: SLO
+    busy: bool = False
+    epochs: int = 0
+
+
+class RefreshService:
+    """See module docstring. Construct, `admit` committees, `start()`,
+    then `submit`/`wait`/`drain`; `stop()` joins the threads."""
+
+    def __init__(
+        self,
+        policy: Optional[BatchPolicy] = None,
+        planner: Optional[CapacityPlanner] = None,
+        workers: Optional[int] = None,
+    ):
+        self.policy = policy or BatchPolicy(devices=_device_count())
+        self.planner = planner or CapacityPlanner()
+        if workers is None:
+            try:
+                workers = int(os.environ.get("FSDKR_SERVE_WORKERS", "1"))
+            except ValueError:
+                workers = 1
+        self.workers = max(1, workers)
+        self._committees: Dict[object, _Committee] = {}
+        # ACTIVE sessions only; finished ones move to the bounded
+        # history below so a long-running service cannot grow without
+        # bound (and stats() never scans more than inflight + history)
+        self._sessions: Dict[int, ServeSession] = {}
+        self._finished: "OrderedDict[int, ServeSession]" = OrderedDict()
+        try:
+            self._history = max(
+                1, int(os.environ.get("FSDKR_SERVE_HISTORY", "65536"))
+            )
+        except ValueError:
+            self._history = 65536
+        self._queue: deque = deque()  # session ids, FIFO (public metadata)
+        self._ready: List[int] = []  # quorum-ready session ids
+        self._lock = threading.Lock()
+        self._work_cv = threading.Condition(self._lock)
+        self._ready_cv = threading.Condition(self._lock)
+        self._next_id = 0
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._inflight = 0
+        self.sessions_done = 0
+        self.sessions_aborted = 0
+
+    # -- committee membership -------------------------------------------
+    def admit(
+        self,
+        committee_id,
+        keys: Sequence,
+        config: ProtocolConfig = DEFAULT_CONFIG,
+        slo: SLO = SLO(),
+    ) -> None:
+        """Register a committee (its parties' LocalKeys, in index order)
+        and install its SLO-derived pool targets."""
+        with self._lock:
+            if committee_id in self._committees:
+                raise ValueError(f"committee {committee_id!r} already admitted")
+            self._committees[committee_id] = _Committee(
+                keys=list(keys), config=config, slo=slo
+            )
+            metrics.committees_gauge().set(len(self._committees))
+        self.planner.register(committee_id, keys[0], len(keys), config, slo)
+
+    def evict(self, committee_id) -> None:
+        """Remove a committee; its pool targets are invalidated and the
+        pooled single-use secrets wiped now (churn discipline)."""
+        with self._lock:
+            com = self._committees.pop(committee_id, None)
+            metrics.committees_gauge().set(len(self._committees))
+        if com is not None:
+            self.planner.invalidate(committee_id)
+
+    # -- session intake -------------------------------------------------
+    def submit(self, committee_id) -> int:
+        """Enqueue one refresh session for the committee; returns the
+        session id. With FSDKR_SERVE=0 the session runs synchronously
+        (single-shot barrier semantics) before this returns."""
+        now = time.monotonic()
+        with self._lock:
+            if committee_id not in self._committees:
+                raise KeyError(f"committee {committee_id!r} not admitted")
+            self._next_id += 1
+            sess = ServeSession(
+                session_id=self._next_id,
+                committee_id=committee_id,
+                submitted_at=now,
+            )
+            self._sessions[sess.session_id] = sess
+            self._inflight += 1
+            metrics.inflight_gauge().set(self._inflight)
+            if enabled():
+                sess.state = "pooled"
+                self._queue.append(sess.session_id)
+                metrics.queue_gauge().set(len(self._queue))
+                self._work_cv.notify()
+                return sess.session_id
+        # FSDKR_SERVE=0: today's single-shot path, inline
+        self._run_single_shot(sess)
+        return sess.session_id
+
+    def wait(self, session_id: int, timeout: Optional[float] = None) -> ServeSession:
+        with self._lock:
+            sess = self._sessions.get(session_id) or self._finished.get(
+                session_id
+            )
+        if sess is None:
+            raise KeyError(
+                f"session {session_id} unknown (finished sessions are "
+                f"retained up to FSDKR_SERVE_HISTORY={self._history})"
+            )
+        sess._done_evt.wait(timeout)
+        return sess
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Block until every submitted session finished (True) or the
+        timeout elapsed (False)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._inflight == 0:
+                    return True
+            time.sleep(0.01)
+        with self._lock:
+            return self._inflight == 0
+
+    # -- service threads ------------------------------------------------
+    def start(self) -> None:
+        if self._threads:
+            return
+        self._stop.clear()
+        for w in range(self.workers):
+            t = threading.Thread(
+                target=self._worker_loop, name=f"fsdkr-serve-worker-{w}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(
+            target=self._launcher_loop, name="fsdkr-serve-launcher", daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        with self._lock:
+            self._work_cv.notify_all()
+            self._ready_cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        self._threads.clear()
+
+    # -- internals: prover/stream side ----------------------------------
+    def _pop_work(self) -> Optional[ServeSession]:
+        """Pop the first queued session whose committee is idle (FIFO
+        per committee; other committees' sessions overtake a busy one)."""
+        for idx, sid in enumerate(self._queue):
+            sess = self._sessions[sid]
+            com = self._committees.get(sess.committee_id)
+            if com is None:
+                # evicted mid-queue: abort below, outside the scan
+                del self._queue[idx]
+                return sess
+            if not com.busy:
+                com.busy = True
+                del self._queue[idx]
+                return sess
+        return None
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                sess = self._pop_work()
+                if sess is None:
+                    self._work_cv.wait(timeout=0.05)
+                    continue
+                metrics.queue_gauge().set(len(self._queue))
+                com = self._committees.get(sess.committee_id)
+            if com is None:
+                self._finish(sess, RuntimeError("committee evicted"), time.monotonic())
+                continue
+            try:
+                self._run_session(sess, com)
+            except Exception as e:  # distribute/offer failures
+                with self._lock:
+                    com.busy = False
+                    self._work_cv.notify()
+                self._finish(sess, e, time.monotonic())
+
+    def _run_session(self, sess: ServeSession, com: _Committee) -> None:
+        now = time.monotonic()
+        metrics.record_phase("queue", now - sess.submitted_at)
+        sess.started_at = now
+        sess.state = "distributing"
+        keys, config = com.keys, com.config
+        new_n = len(keys)
+        owner = serve_owner(sess.committee_id)
+        with precompute.owner_scope(owner):
+            results = RefreshMessage.distribute_batch(
+                [(k.i, k) for k in keys], new_n, config
+            )
+        t_dist = time.monotonic()
+        metrics.record_phase("distribute", t_dist - now)
+
+        msgs = [m for m, _ in results]
+        sess.state = "collecting"
+        expected = [k.i for k in keys]
+        streams = [
+            RefreshMessage.collect_stream(k, results[idx][1], expected, (), config)
+            for idx, k in enumerate(keys)
+        ]
+        # simulated broadcast arrival: each message lands at every
+        # collector before the next arrives; order is session-seeded so
+        # reordering is exercised continuously in production-like runs
+        order = list(msgs)
+        if _shuffle_arrivals():
+            random.Random(sess.session_id).shuffle(order)
+        for m in order:
+            for st in streams:
+                st.offer(m)
+        t_stream = time.monotonic()
+        metrics.record_phase("stream", t_stream - t_dist)
+
+        sess._streams = streams
+        sess._config = config
+        sess.quorum_at = t_stream
+        with self._lock:
+            sess.state = "ready"
+            self._ready.append(sess.session_id)
+            self._ready_cv.notify()
+
+    # -- internals: coalescing finalize side ----------------------------
+    def _pick_batch(self) -> List[ServeSession]:
+        """Under the lock: choose the batch to finalize now (oldest
+        config group, policy-sized), or [] to keep lingering."""
+        if not self._ready:
+            return []
+        groups: Dict[object, List[ServeSession]] = {}
+        for sid in self._ready:
+            s = self._sessions[sid]
+            groups.setdefault(s._config, []).append(s)
+        # oldest-first: the group containing the longest-waiting session
+        group = min(groups.values(), key=lambda g: g[0].quorum_at)
+        oldest_wait = time.monotonic() - group[0].quorum_at
+        rows = 0
+        if group[0]._streams:
+            st0 = group[0]._streams[0]
+            rows = len(st0.expected) * st0.new_n * len(group[0]._streams)
+        count = self.policy.take(len(group), oldest_wait, rows)
+        if count <= 0:
+            return []
+        batch = group[:count]
+        taken = {s.session_id for s in batch}
+        self._ready = [sid for sid in self._ready if sid not in taken]
+        return batch
+
+    def _launcher_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                batch = self._pick_batch()
+                if not batch:
+                    self._ready_cv.wait(timeout=0.02)
+                    continue
+            self._finalize_batch(batch)
+
+    def _finalize_batch(self, batch: List[ServeSession]) -> None:
+        t0 = time.monotonic()
+        config = batch[0]._config
+        streams = []
+        for sess in batch:
+            sess.state = "finalizing"
+            metrics.record_phase("coalesce", t0 - sess.quorum_at)
+            streams.extend(sess._streams)
+        metrics.batch_histogram().observe(len(streams))
+        errors = finalize_streams(streams, config)
+        t1 = time.monotonic()
+        pos = 0
+        for sess in batch:
+            n = len(sess._streams)
+            errs = [e for e in errors[pos : pos + n] if e is not None]
+            pos += n
+            metrics.record_phase("finalize", t1 - t0)
+            self._finish(sess, errs[0] if errs else None, t1)
+
+    def _finish(self, sess: ServeSession, error: Optional[Exception], now: float) -> None:
+        sess.finalized_at = now
+        sess._streams = []
+        if error is None:
+            sess.state = "done"
+        else:
+            sess.state = "aborted"
+            sess.error = f"{type(error).__name__}: {error}"
+        with self._lock:
+            com = self._committees.get(sess.committee_id)
+            if com is not None:
+                com.busy = False
+                if error is None:
+                    com.epochs += 1
+                self._work_cv.notify()
+            self._inflight -= 1
+            self.sessions_done += error is None
+            self.sessions_aborted += error is not None
+            metrics.inflight_gauge().set(self._inflight)
+            # retire into the bounded history (memory stays O(history))
+            self._sessions.pop(sess.session_id, None)
+            self._finished[sess.session_id] = sess
+            while len(self._finished) > self._history:
+                self._finished.popitem(last=False)
+        metrics.record_outcome(
+            "done" if error is None else "aborted", now - sess.submitted_at
+        )
+        # the committee's eks just rotated (or the session died): refresh
+        # the SLO-derived pool targets against the live key state and
+        # wake the producer — collect's kick has often drained by now
+        if error is None:
+            self.planner.retarget(sess.committee_id)
+            precompute.kick()
+        sess._done_evt.set()
+
+    # -- FSDKR_SERVE=0: the single-shot arm -----------------------------
+    def _run_single_shot(self, sess: ServeSession) -> None:
+        """Today's barrier API, synchronously: distribute_batch + fused
+        barrier collect_sessions for every party, no streaming and no
+        coalescing. Keeps the lifecycle/metrics surface so A/B runs
+        compare like for like."""
+        com = self._committees[sess.committee_id]
+        # same one-session-per-committee rule as the scheduler: a
+        # concurrent synchronous submit would race the key mutation
+        with self._lock:
+            if com.busy:
+                # un-admit the session before refusing, so the inflight
+                # accounting stays exact
+                self._inflight -= 1
+                self._sessions.pop(sess.session_id, None)
+                metrics.inflight_gauge().set(self._inflight)
+                raise RuntimeError(
+                    "committee busy: the single-shot arm serializes "
+                    "sessions per committee in the caller"
+                )
+            com.busy = True
+        keys, config = com.keys, com.config
+        now = time.monotonic()
+        sess.started_at = now
+        sess.state = "distributing"
+        error: Optional[Exception] = None
+        try:
+            with precompute.owner_scope(serve_owner(sess.committee_id)):
+                results = RefreshMessage.distribute_batch(
+                    [(k.i, k) for k in keys], len(keys), config
+                )
+            msgs = [m for m, _ in results]
+            sess.state = "collecting"
+            errs = RefreshMessage.collect_sessions(
+                [(msgs, k, results[idx][1], ()) for idx, k in enumerate(keys)],
+                config,
+            )
+            error = next((e for e in errs if e is not None), None)
+        except Exception as e:
+            error = e
+        sess.quorum_at = time.monotonic()
+        self._finish(sess, error, time.monotonic())
+
+    # -- introspection --------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            # active sessions only: the scan is bounded by inflight, not
+            # by the lifetime session count
+            states: Dict[str, int] = {}
+            for s in self._sessions.values():
+                states[s.state] = states.get(s.state, 0) + 1
+            states["done"] = self.sessions_done
+            states["aborted"] = self.sessions_aborted
+            return {
+                "committees": len(self._committees),
+                "inflight": self._inflight,
+                "queued": len(self._queue),
+                "ready": len(self._ready),
+                "sessions_done": self.sessions_done,
+                "sessions_aborted": self.sessions_aborted,
+                "states": states,
+            }

@@ -113,4 +113,30 @@ FSDKR_PRECOMPUTE=0 python -m pytest tests/test_precompute.py \
   tests/test_protocol.py tests/test_proofs.py -q \
   -m "not slow and not heavy" -p no:cacheprovider
 
+echo "== test: serving smoke leg (RefreshService loadgen) =="
+# a short sustained run through the whole serving loop (admission ->
+# distribute -> streaming collect -> coalesced fused finalize -> pool
+# retarget): asserts sessions actually complete and the serving
+# telemetry artifacts materialize, so the service cannot rot between
+# the full measure_all serve_sustained runs
+rm -f /tmp/fsdkr_ci_serving.json /tmp/fsdkr_ci_serving.prom
+FSDKR_METRICS_DUMP=/tmp/fsdkr_ci_serving.prom \
+  python scripts/loadgen.py --committees 8 --bases 2 --window 6 --rate 2 \
+  --prefill-wait 15 --drain-timeout 180 --tag ci \
+  --out /tmp/fsdkr_ci_serving.json > /dev/null
+python - <<'EOF'
+import json
+rep = json.load(open("/tmp/fsdkr_ci_serving.json"))
+assert rep["sessions_done"] > 0, "no serving sessions completed"
+assert rep["sessions_aborted"] == 0, rep["abort_errors"]
+assert rep["latency_s"]["p99"] is not None
+tel = rep["telemetry"]["metrics"]
+assert "fsdkr_serving_phase_seconds" in tel, "serving histogram missing"
+assert "fsdkr_serving_sessions" in tel, "serving counter missing"
+prom = open("/tmp/fsdkr_ci_serving.prom").read()
+assert "fsdkr_serving_sessions" in prom, "prom exposition missing serving"
+print("serving smoke leg ok:", rep["sessions_done"], "sessions, p99",
+      rep["latency_s"]["p99"], "s, dry", rep["pool"]["dry_fallback_rate"])
+EOF
+
 echo "== ci.sh: all gates green =="

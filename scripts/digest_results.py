@@ -16,15 +16,24 @@ import sys
 
 
 def load(path):
+    text = path.read_text()
+    # whole-file object first (pretty-printed reports); JSON-lines after
+    try:
+        rec = json.loads(text)
+        return [rec] if isinstance(rec, dict) else []
+    except json.JSONDecodeError:
+        pass
     recs = []
-    for line in path.read_text().splitlines():
+    for line in text.splitlines():
         line = line.strip()
         if not line:
             continue
         try:
-            recs.append(json.loads(line))
+            rec = json.loads(line)
         except json.JSONDecodeError:
-            pass
+            continue
+        if isinstance(rec, dict):
+            recs.append(rec)
     return recs
 
 
@@ -32,8 +41,12 @@ def main():
     root = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else "bench_results")
     configs, kernels, traces, ec_ab = [], [], {}, []
     mfu, other_kernel_recs = [], 0
-    for path in sorted(root.glob("m_*.json")):
-        name = path.stem[2:]
+    serving = []
+    # serving reports live both as battery steps (m_serve_*.json) and as
+    # the loadgen's own serving_*.json artifacts
+    paths = sorted(root.glob("m_*.json")) + sorted(root.glob("serving_*.json"))
+    for path in paths:
+        name = path.stem[2:] if path.stem.startswith("m_") else path.stem
         for rec in load(path):
             if "kernel" in rec and "seconds" in rec:
                 kernels.append(rec)  # bench_kernels.py sweep rows
@@ -45,6 +58,19 @@ def main():
                 other_kernel_recs += 1
             elif "shape" in rec:  # scripts/bench_ec.py A/B records
                 ec_ab.append(rec)
+            elif rec.get("metric") == "serve_sustained":
+                # the same run exists twice on disk (the battery's
+                # m_serve_*.json stdout capture AND loadgen's own
+                # serving_*.json) — dedup by run content, not file name
+                fp = tuple(
+                    (rec.get(k) if not isinstance(rec.get(k), dict)
+                     else tuple(sorted(rec[k].items())))
+                    for k in ("committees", "window_s", "arrivals",
+                              "sessions_done", "offered_rate_hz",
+                              "latency_s")
+                )
+                if not any(f == fp for _n, _r, f in serving):
+                    serving.append((name, rec, fp))
             elif "metric" in rec:
                 configs.append((name, rec))
                 if rec.get("trace"):
@@ -166,6 +192,58 @@ def main():
             print("|---|---|")
             for g, v in gauge_rows:
                 print(f"| {g} | {v} |")
+            print()
+
+    if serving:
+        # serving sustained-load report (ISSUE 9, scripts/loadgen.py)
+        print("### serving: sustained multi-committee load (loadgen)\n")
+        print("| step | platform | committees | n | bits | window s "
+              "| offered/s | done/s (win) | p50 s | p95 s | p99 s "
+              "| dry rate | aborted |")
+        print("|---|---|---|---|---|---|---|---|---|---|---|---|---|")
+        for name, r, _fp in serving:
+            lat = r.get("latency_s") or {}
+            pool = r.get("pool") or {}
+            print(
+                f"| {name} | {r.get('platform', '—')} "
+                f"| {r.get('committees', '—')} | {r.get('n', '—')} "
+                f"| {r.get('paillier_bits', '—')} "
+                f"| {r.get('window_s', '—')} "
+                f"| {r.get('offered_rate_hz', '—')} "
+                f"| {r.get('sessions_per_s', '—')} "
+                f"| {lat.get('p50', '—')} | {lat.get('p95', '—')} "
+                f"| {lat.get('p99', '—')} "
+                f"| {pool.get('dry_fallback_rate', '—')} "
+                f"| {r.get('sessions_aborted', '—')} |"
+            )
+        print()
+        for name, r, _fp in serving:
+            # pool occupancy / dry-fallback table per run
+            metrics = (r.get("telemetry") or {}).get("metrics") or {}
+            depth = {
+                v["labels"].get("kind", "?"): v["value"]
+                for v in metrics.get("fsdkr_pool_depth", {}).get("values", [])
+            }
+            events = {}
+            for v in metrics.get("fsdkr_pool_events", {}).get("values", []):
+                k = v["labels"].get("kind", "?")
+                events.setdefault(k, {})[v["labels"].get("event", "?")] = int(
+                    v["value"]
+                )
+            if not depth and not events:
+                continue
+            print(f"#### pool occupancy / dry fallbacks: {name}\n")
+            print("| kind | pooled now | produced | consumed "
+                  "| dry fallbacks | wiped |")
+            print("|---|---|---|---|---|---|")
+            for kind in sorted(set(depth) | set(events)):
+                ev = events.get(kind, {})
+                print(
+                    f"| {kind} | {int(depth.get(kind, 0))} "
+                    f"| {ev.get('produced', 0)} | {ev.get('consumed', 0)} "
+                    f"| {ev.get('dry_fallbacks', 0)} "
+                    f"| {ev.get('wiped', 0)} |"
+                )
             print()
 
     if kernels:

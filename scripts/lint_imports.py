@@ -4,6 +4,12 @@ imports, per file, via the ast module. Conservative by design —
 `__all__` entries, re-export modules (__init__.py), names starting with
 '_', and names referenced from quoted string annotations are exempt.
 
+Also enforces LAYERING rules (ISSUE 9): `fsdkr_tpu/serving` is an
+orchestration layer and must reach the cryptography only through the
+protocol surface — importing `proofs`, `backend`, `ops`, `native`, or
+`core` internals from serving (absolute or relative) is a finding, so a
+violation fails ci.sh instead of fossilizing.
+
 Usage: python scripts/lint_imports.py [paths...]   (default: fsdkr_tpu)
 Exit code 1 if any finding (ci.sh lint gate).
 """
@@ -12,11 +18,69 @@ import ast
 import pathlib
 import sys
 
+# package-dir -> module prefixes its files must not import. Checked for
+# every *.py under the directory, __init__.py included.
+LAYERING_RULES = {
+    "fsdkr_tpu/serving": (
+        "fsdkr_tpu.proofs",
+        "fsdkr_tpu.backend",
+        "fsdkr_tpu.ops",
+        "fsdkr_tpu.native",
+        "fsdkr_tpu.core",
+    ),
+}
+
+
+def _abs_module(node, path: pathlib.Path):
+    """Absolute dotted module of an ImportFrom, resolving relative
+    imports against the file's package (CPython semantics: __package__
+    is the containing package for BOTH regular modules and __init__.py,
+    and level N strips N-1 trailing components from it)."""
+    if node.level == 0:
+        return node.module or ""
+    parts = path.resolve().parts
+    try:
+        root = parts.index("fsdkr_tpu")
+    except ValueError:
+        return node.module or ""
+    pkg = list(parts[root:-1])  # the module's package path
+    base = pkg[: len(pkg) - (node.level - 1)] if node.level > 1 else pkg
+    return ".".join(base + ([node.module] if node.module else []))
+
+
+def check_layering(path: pathlib.Path, tree) -> list:
+    rel = path.as_posix()
+    rules = [
+        banned
+        for prefix, banned in LAYERING_RULES.items()
+        if f"/{prefix}/" in f"/{rel}" or rel.startswith(prefix + "/")
+    ]
+    if not rules:
+        return []
+    banned = tuple(b for rule in rules for b in rule)
+    findings = []
+    for node in ast.walk(tree):
+        mods = []
+        if isinstance(node, ast.Import):
+            mods = [a.name for a in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            mods = [_abs_module(node, path)]
+        for mod in mods:
+            for b in banned:
+                if mod == b or mod.startswith(b + "."):
+                    findings.append(
+                        f"{path}:{node.lineno}: layering violation: "
+                        f"serving must not import {mod!r} (use the "
+                        f"protocol surface)"
+                    )
+    return findings
+
 
 def check_file(path: pathlib.Path):
     tree = ast.parse(path.read_text(), filename=str(path))
+    layering = check_layering(path, tree)
     if path.name == "__init__.py":
-        return []  # re-export wiring: imports are the point
+        return layering  # re-export wiring: imports are the point
 
     exported = set()
     for node in ast.walk(tree):
@@ -64,7 +128,7 @@ def check_file(path: pathlib.Path):
             if isinstance(n, ast.Name):
                 used.add(n.id)
 
-    findings = []
+    findings = layering
     for name, lineno in sorted(imported.items(), key=lambda kv: kv[1]):
         if name in used or name in exported or name.startswith("_"):
             continue

@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""Serving load generator (ISSUE 9): Poisson refresh arrivals across
+hundreds-to-thousands of concurrent committees through RefreshService,
+reporting sustained sessions/sec + exact end-to-end latency percentiles
++ pool economics into bench_results/serving_*.json.
+
+Phases:
+  1. keygen `--bases` distinct committees at the serve parameters and
+     clone them out to `--committees` (cloned committees share auxiliary
+     mod-N~ parameters until their first epoch rotates every Paillier
+     key, after which all pool keys are genuinely per-committee; the
+     clone count is reported, never hidden).
+  2. admit everything, run one unmeasured seed epoch per committee
+     (registers each committee's SLO-derived pool targets keyed by its
+     post-seed key material and warms the persistent engine caches).
+  3. prefill wait: let the background producer fill the planned depth
+     targets (bounded by --prefill-wait).
+  4. the measured window (--window seconds): open-loop Poisson arrivals
+     at --rate sessions/sec over uniformly random committees, then
+     drain. Pool dry-fallback counters are snapshotted at the window
+     edges so the steady-state dry rate excludes setup.
+
+Honesty rules (matching bench.py): the JSON carries the platform tag,
+every knob that shaped the run, offered vs completed rate, shed
+arrivals (backlog cap), and the full telemetry snapshot. Exact
+percentiles come from per-session wall clocks, not histogram
+interpolation.
+
+Usage (acceptance shape, fallback platform):
+  python scripts/loadgen.py --committees 200 --window 60
+Smoke (ci.sh):
+  python scripts/loadgen.py --committees 8 --bases 2 --window 5 --rate 2
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import random
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--committees", type=int, default=200)
+    p.add_argument("--bases", type=int, default=4,
+                   help="distinct keygen committees cloned out to --committees")
+    p.add_argument("--n", type=int, default=3, help="committee size")
+    p.add_argument("--t", type=int, default=1, help="threshold")
+    p.add_argument("--bits", type=int, default=640,
+                   help="Paillier modulus bits (640 = smallest exact-recovery size)")
+    p.add_argument("--m-security", type=int, default=8)
+    p.add_argument("--ck-rounds", type=int, default=2)
+    p.add_argument("--backend", default="tpu",
+                   help="protocol backend (tpu = batched engines, auto-routed)")
+    p.add_argument("--window", type=float, default=60.0,
+                   help="measured window seconds")
+    p.add_argument("--rate", type=float, default=0.0,
+                   help="offered sessions/sec (0 = auto: ~70%% of calibrated capacity)")
+    p.add_argument("--seed-epochs", type=int, default=1)
+    p.add_argument("--prefill-wait", type=float, default=60.0)
+    p.add_argument("--drain-timeout", type=float, default=300.0)
+    p.add_argument("--max-backlog", type=int, default=64,
+                   help="arrivals shed (not queued) beyond this in-flight count")
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--tag", default="sustained")
+    p.add_argument("--out", default=None,
+                   help="report path (default bench_results/serving_<tag>.json)")
+    return p.parse_args()
+
+
+def percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return round(sorted_vals[idx], 4)
+
+
+def main():
+    args = parse_args()
+    t_start = time.time()
+
+    from fsdkr_tpu import precompute
+    from fsdkr_tpu.config import ProtocolConfig
+    from fsdkr_tpu.protocol import simulate_keygen
+    from fsdkr_tpu.serving import RefreshService, SLO, enabled as serve_enabled
+    from fsdkr_tpu.telemetry import export as tel_export
+
+    config = ProtocolConfig(
+        paillier_bits=args.bits,
+        m_security=args.m_security,
+        correct_key_rounds=args.ck_rounds,
+        backend=args.backend,
+    )
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "unknown"
+
+    rng = random.Random(args.seed)
+
+    # ---- phase 1: committees -----------------------------------------
+    log = lambda *a: print(*a, file=sys.stderr, flush=True)
+    log(f"[loadgen] keygen {args.bases} base committees "
+        f"(n={args.n}, t={args.t}, {args.bits}-bit)")
+    t0 = time.time()
+    keygen = getattr(simulate_keygen, "uncached", simulate_keygen)
+    bases = [keygen(args.t, args.n, config) for _ in range(args.bases)]
+    committees = {
+        cid: [k.clone() for k in bases[cid % args.bases]]
+        for cid in range(args.committees)
+    }
+    keygen_s = time.time() - t0
+    log(f"[loadgen] keygen {keygen_s:.1f}s; admitting {args.committees} committees")
+
+    svc = RefreshService()
+    # per-committee rate: the offered total spread uniformly
+    per_rate = (args.rate or 1.0) / max(1, args.committees)
+    for cid, keys in committees.items():
+        svc.admit(cid, keys, config, SLO(arrival_rate_hz=per_rate))
+    svc.start()
+
+    # ---- phase 2: seed epochs ----------------------------------------
+    t0 = time.time()
+    for _epoch in range(args.seed_epochs):
+        for cid in committees:
+            svc.submit(cid)
+        if not svc.drain(timeout=max(args.drain_timeout, 12 * args.committees)):
+            log("[loadgen] WARNING: seed epoch did not drain; continuing")
+    seed_s = time.time() - t0
+    st = svc.stats()
+    seed_done = st["sessions_done"]
+    log(f"[loadgen] seeded {seed_done} sessions in {seed_s:.1f}s "
+        f"({seed_done / seed_s:.2f}/s single-stream)")
+
+    # auto rate: ~70% of the calibrated closed-loop capacity so the
+    # producer has idle time to keep pools at depth (open-loop at or
+    # above capacity is a queueing divergence, not a steady state)
+    rate = args.rate
+    if rate <= 0:
+        rate = max(0.1, 0.7 * seed_done / seed_s) if seed_s > 0 else 1.0
+        log(f"[loadgen] auto rate: {rate:.2f} sessions/s")
+
+    # ---- phase 3: prefill wait ---------------------------------------
+    t0 = time.time()
+    precompute.kick()
+    deficit0 = precompute.deficit_total()
+    while time.time() - t0 < args.prefill_wait:
+        if precompute.deficit_total() == 0:
+            break
+        time.sleep(0.25)
+    prefill_s = time.time() - t0
+    deficit_left = precompute.deficit_total()
+    log(f"[loadgen] prefill {prefill_s:.1f}s "
+        f"(deficit {deficit0} -> {deficit_left})")
+
+    # ---- phase 4: measured window ------------------------------------
+    from fsdkr_tpu.serving import metrics as smetrics
+
+    smetrics.phase_histogram().reset()
+    smetrics.sessions_counter().reset()
+    smetrics.batch_histogram().reset()
+    pool0 = precompute.precompute_stats()
+    win_ids = []
+    shed = 0
+    cids = list(committees)
+    t_win = time.monotonic()
+    next_arrival = t_win
+    while True:
+        now = time.monotonic()
+        if now - t_win >= args.window:
+            break
+        if now < next_arrival:
+            time.sleep(min(0.005, next_arrival - now))
+            continue
+        next_arrival += rng.expovariate(rate)
+        if svc.stats()["inflight"] >= args.max_backlog:
+            shed += 1
+            continue
+        win_ids.append(svc.submit(rng.choice(cids)))
+    window_s = time.monotonic() - t_win
+    drained = svc.drain(timeout=args.drain_timeout)
+    drain_s = time.monotonic() - t_win - window_s
+    pool1 = precompute.precompute_stats()
+
+    sessions = [svc.wait(sid, 0) for sid in win_ids]
+    done = [s for s in sessions if s.state == "done"]
+    aborted = [s for s in sessions if s.state == "aborted"]
+    # completed-inside-window throughput (the sustained figure) plus the
+    # drain-inclusive one (total work the window's offered load produced)
+    done_in_window = [
+        s for s in done if s.finalized_at - t_win <= args.window
+    ]
+    lat = sorted(s.finalized_at - s.submitted_at for s in done)
+    consumed = pool1["consumed"] - pool0["consumed"]
+    dry = pool1["dry_fallbacks"] - pool0["dry_fallbacks"]
+    takes = consumed + dry
+    dry_rate = round(dry / takes, 4) if takes else None
+
+    prod = {}
+    for rec in tel_export.snapshot()["metrics"].get(
+        "fsdkr_producer_occupancy", {}
+    ).get("values", []):
+        prod["occupancy"] = round(rec["value"], 4)
+
+    report = {
+        "metric": "serve_sustained",
+        "platform": platform,
+        "fsdkr_serve": serve_enabled(),
+        "committees": args.committees,
+        "distinct_bases": args.bases,
+        "n": args.n,
+        "t": args.t,
+        "paillier_bits": args.bits,
+        "m_security": args.m_security,
+        "correct_key_rounds": args.ck_rounds,
+        "window_s": round(window_s, 2),
+        "drain_s": round(drain_s, 2),
+        "drained": drained,
+        "offered_rate_hz": round(rate, 4),
+        "arrivals": len(win_ids),
+        "shed": shed,
+        "sessions_done": len(done),
+        "sessions_done_in_window": len(done_in_window),
+        "sessions_aborted": len(aborted),
+        "abort_errors": sorted({s.error for s in aborted})[:5],
+        "sessions_per_s": round(len(done_in_window) / window_s, 4),
+        "sessions_per_s_incl_drain": (
+            round(len(done) / (window_s + drain_s), 4)
+            if window_s + drain_s > 0 else None
+        ),
+        "latency_s": {
+            "p50": percentile(lat, 0.50),
+            "p95": percentile(lat, 0.95),
+            "p99": percentile(lat, 0.99),
+            "mean": round(sum(lat) / len(lat), 4) if lat else None,
+            "max": round(lat[-1], 4) if lat else None,
+        },
+        "pool": {
+            "consumed": consumed,
+            "dry_fallbacks": dry,
+            "dry_fallback_rate": dry_rate,
+            "produced": pool1["produced"] - pool0["produced"],
+            "bytes_pooled": pool1["bytes_pooled"],
+            "entries_pooled": pool1["entries"],
+            "pools": pool1["pools"],
+            "prefill_deficit_left": deficit_left,
+        },
+        "producer": prod,
+        "setup": {
+            "keygen_s": round(keygen_s, 1),
+            "seed_epochs": args.seed_epochs,
+            "seed_s": round(seed_s, 1),
+            "seed_sessions_per_s": (
+                round(seed_done / seed_s, 3) if seed_s > 0 else None
+            ),
+            "prefill_s": round(prefill_s, 1),
+        },
+        "knobs": {
+            "FSDKR_SERVE_BATCH": svc.policy.max_sessions,
+            "FSDKR_SERVE_LINGER_MS": round(svc.policy.linger_s * 1000, 1),
+            "FSDKR_SERVE_WORKERS": svc.workers,
+            "FSDKR_SERVE_HORIZON_S": svc.planner.horizon_s,
+            "FSDKR_SERVE_MAX_AHEAD": svc.planner.max_ahead,
+            "FSDKR_POOL_DEPTH": os.environ.get("FSDKR_POOL_DEPTH", "64"),
+            "max_backlog": args.max_backlog,
+        },
+        "telemetry": tel_export.snapshot(),
+    }
+    svc.stop()
+    precompute.stop_background()
+
+    out = args.out or f"bench_results/serving_{args.tag}.json"
+    pathlib.Path(out).parent.mkdir(parents=True, exist_ok=True)
+    pathlib.Path(out).write_text(json.dumps(report, indent=1) + "\n")
+    log(f"[loadgen] report -> {out} (total wall {time.time() - t_start:.0f}s)")
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

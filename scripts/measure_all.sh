@@ -226,6 +226,18 @@ fi
 run_local "n16_host_tauto" 3600 BENCH_PLATFORM=cpu FSDKR_THREADS=auto \
   FSDKR_DEVICE_POWM=0 FSDKR_DEVICE_EC=0 FSDKR_TRACE=1 python bench.py
 
+# serving sustained load (ISSUE 9): the refresh-as-a-service acceptance
+# shape — >=200 concurrent committees, >=60 s measured window of Poisson
+# arrivals through RefreshService (streaming collect, coalesced fused
+# finalize launches, SLO-driven pool capacity planning). Pinned to the
+# host platform (run_local) so the step survives a tunnel outage; the
+# loadgen also writes bench_results/serving_sustained.json itself, and
+# digest_results.py renders the sessions/sec + latency-percentile +
+# pool-occupancy tables from either copy.
+run_local serve_sustained 3000 JAX_PLATFORMS=cpu \
+  python scripts/loadgen.py --committees 200 --bases 4 --window 60 \
+  --prefill-wait 90 --tag sustained
+
 # canonical BENCH datapoint from the battery, copied to the repo root so
 # the round's bench trajectory is populated even if the driver never
 # runs bench.py itself: prefer the on-chip n16 step, fall back to the

@@ -1,0 +1,255 @@
+"""RefreshService / capacity planner / batching policy (ISSUE 9).
+
+Protocol-level correctness of the serving loop lives in
+tests/test_streaming.py (streaming == barrier); here the SCHEDULER is
+under test: lifecycle, coalescing, the FSDKR_SERVE=0 single-shot arm,
+SLO -> depth planning, churn invalidation wiring, and the serving
+metric surface.
+"""
+
+import pytest
+
+from fsdkr_tpu import precompute
+from fsdkr_tpu.core.paillier import EncryptionKey
+from fsdkr_tpu.proofs.composite_dlog import DLogStatement
+from fsdkr_tpu.protocol import simulate_keygen
+from fsdkr_tpu.serving import (
+    SLO,
+    BatchPolicy,
+    CapacityPlanner,
+    RefreshService,
+    serve_owner,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_pools():
+    precompute.clear_targets()
+    precompute.clear_pools()
+    yield
+    precompute.clear_targets()
+    precompute.clear_pools()
+
+
+# ---------------------------------------------------------------------------
+# policy
+
+
+def test_batch_policy_size_and_linger():
+    p = BatchPolicy(max_sessions=4, linger_s=0.5)
+    assert p.take(0, 99.0) == 0
+    assert p.take(2, 0.1) == 0  # under size, under linger: wait
+    assert p.take(2, 0.6) == 2  # linger expired: flush what's there
+    assert p.take(4, 0.0) == 4  # at size: launch now
+    assert p.take(9, 0.0) == 4  # capped at max_sessions
+    assert p.wait_budget(0.1) == pytest.approx(0.4)
+
+
+def test_batch_policy_mesh_alignment():
+    from fsdkr_tpu.parallel.shard_kernels import align_session_batch
+
+    # 8 devices, 12 rows/session: 6 sessions -> 72 rows divides; 5 -> 60
+    # does not, largest aligned k <= 5 is 4 (48 rows)
+    assert align_session_batch(6, 12, 8) == 6
+    assert align_session_batch(5, 12, 8) == 4
+    assert align_session_batch(3, 12, 8) == 2
+    assert align_session_batch(5, 12, 1) == 5  # single device: no-op
+    assert align_session_batch(3, 7, 8) == 3  # no aligned k: unchanged
+    p = BatchPolicy(max_sessions=6, linger_s=0.0, devices=8)
+    assert p.take(5, 1.0, rows_per_session=12) == 4
+
+
+# ---------------------------------------------------------------------------
+# planner
+
+
+def _fake_committee(n=3, bits=64):
+    """Synthetic LocalKey stand-in for target math: committee_targets
+    only reads paillier_key_vec[i].n and h1_h2_n_tilde_vec[i] fields."""
+
+    class FakeKey:
+        pass
+
+    k = FakeKey()
+    k.paillier_key_vec = [
+        EncryptionKey.from_n((1 << bits) + 100 * i + 1) for i in range(n)
+    ]
+    k.h1_h2_n_tilde_vec = [
+        DLogStatement(N=(1 << bits) + 200 * i + 3, g=2 + i, ni=5 + i)
+        for i in range(n)
+    ]
+    return k
+
+
+def test_planner_depth_math(test_config):
+    pl = CapacityPlanner(horizon_s=30.0, max_ahead=4)
+    assert pl.epochs_ahead(SLO(arrival_rate_hz=0.001)) == 1
+    assert pl.epochs_ahead(SLO(arrival_rate_hz=0.1)) == 3
+    assert pl.epochs_ahead(SLO(arrival_rate_hz=10.0)) == 4  # clamped
+    fk = _fake_committee()
+    pl.register("c1", fk, 3, test_config, SLO(arrival_rate_hz=0.2))
+    # keys demand aggregates across committees sharing the config
+    w1 = pl.keys_want(test_config)
+    pl.register("c2", _fake_committee(), 3, test_config, SLO(arrival_rate_hz=0.2))
+    assert pl.keys_want(test_config) > w1
+
+
+def test_planner_register_retarget_invalidate(test_config):
+    pl = CapacityPlanner(horizon_s=10.0, max_ahead=2)
+    fk = _fake_committee()
+    pl.register("com", fk, 3, test_config, SLO(arrival_rate_hz=0.5))
+    owned = precompute.target_keys(owner=serve_owner("com"))
+    assert len(owned) == 9  # 3 receivers x enc/pdl/alice; keys is fleet-owned
+    assert precompute.target_keys(owner=precompute.KEYS_POOL_OWNER)
+    # fill one owned pool, then rotate one receiver's modulus: retarget
+    # must wipe the stale pool and target
+    kind, key = next(k for k in owned if k[0] == "enc")
+    precompute.put(kind, key, (5, 7))
+    assert precompute.get_store().depth(kind, key) == 1
+    fk.paillier_key_vec[0] = EncryptionKey.from_n((1 << 64) + 9999)
+    pl.retarget("com")
+    assert (kind, key) not in precompute.target_keys(owner=serve_owner("com"))
+    assert precompute.get_store().depth(kind, key) == 0  # wiped
+    # eviction drops everything owned by the committee but NOT the
+    # shared keys pool target
+    pl.invalidate("com")
+    assert precompute.target_keys(owner=serve_owner("com")) == []
+    assert precompute.target_keys(owner=precompute.KEYS_POOL_OWNER)
+
+
+# ---------------------------------------------------------------------------
+# producer churn API (ROADMAP 5a regression)
+
+
+def test_invalidate_owner_wipes_pools():
+    precompute.register_targets(
+        [("enc", 1009, 2), ("enc", 2003, 2)], owner="A"
+    )
+    precompute.register_targets([("enc", 3001, 2)], owner="B")
+    for n in (1009, 2003, 3001):
+        precompute.put("enc", n, (3, 9))
+    stats0 = precompute.precompute_stats()
+    assert precompute.invalidate_owner("A") == 2
+    store = precompute.get_store()
+    assert store.depth("enc", 1009) == 0 and store.depth("enc", 2003) == 0
+    assert store.depth("enc", 3001) == 1  # other owner untouched
+    assert precompute.precompute_stats()["wiped"] == stats0["wiped"] + 2
+    assert precompute.target_keys(owner="A") == []
+    assert precompute.target_keys(owner="B") == [("enc", 3001)]
+
+
+def test_replace_targets_wipes_only_stale():
+    precompute.register_targets([("enc", 11, 1), ("enc", 13, 1)], owner="C")
+    precompute.put("enc", 11, (1, 1))
+    precompute.put("enc", 13, (1, 1))
+    precompute.replace_targets([("enc", 13, 1), ("enc", 17, 1)], owner="C")
+    store = precompute.get_store()
+    assert store.depth("enc", 11) == 0  # stale: wiped
+    assert store.depth("enc", 13) == 1  # still wanted: kept
+    assert sorted(precompute.target_keys(owner="C")) == [
+        ("enc", 13), ("enc", 17),
+    ]
+
+
+@pytest.mark.fresh_committees
+def test_replace_churn_invalidates_stale_pools(test_config):
+    """ROADMAP 5a: a replace() churn explicitly invalidates the pools
+    registered for the pre-churn committee layout — the single-use
+    secrets are wiped NOW, and the post-churn epoch can only consume
+    entries keyed by the live layout."""
+    keys = simulate_keygen(1, 3, test_config)
+    # pre-churn registration: what the last epoch's distribute would
+    # have left behind, keyed by the CURRENT layout's fingerprint owner
+    owner = precompute.committee_owner(keys[0].h1_h2_n_tilde_vec)
+    sentinel = ("enc", keys[0].paillier_key_vec[0].n)
+    precompute.register_targets([sentinel + (2,)], owner=owner)
+    precompute.put(*sentinel, (7, 11))
+    assert precompute.get_store().depth(*sentinel) == 1
+
+    from fsdkr_tpu.protocol import RefreshMessage
+
+    old_n0 = keys[0].paillier_key_vec[0].n
+    msg, dk = RefreshMessage.replace(
+        (), keys[0], {1: 1, 2: 2, 3: 3}, 3, test_config
+    )
+    assert msg.party_index == 1 and dk is not None
+    # the pre-churn registration is gone and its pooled entry wiped:
+    # replace() invalidated the owner, and the epoch's own registration
+    # replaced the target set with next-epoch keys
+    assert sentinel not in precompute.target_keys()
+    assert precompute.get_store().depth(*sentinel) == 0
+    # the post-churn registration is keyed by the NEXT epoch's layout:
+    # the rotated-out modulus appears in no per-receiver target, the
+    # freshly broadcast ek does — so no stale-keyed entry can ever be
+    # consumed by a post-churn epoch
+    next_ns = {msg.ek.n} | {ek.n for ek in keys[0].paillier_key_vec[1:]}
+    targeted_enc = {key for kind, key in precompute.target_keys() if kind == "enc"}
+    assert targeted_enc and targeted_enc <= next_ns
+    assert old_n0 not in targeted_enc
+    for kind, key in precompute.target_keys():
+        if kind in ("pdl", "alice"):
+            assert key[3] in next_ns and key[3] != old_n0
+
+
+# ---------------------------------------------------------------------------
+# the service
+
+
+@pytest.fixture
+def small_service(test_config):
+    base = simulate_keygen(1, 3, test_config)
+    svc = RefreshService(policy=BatchPolicy(max_sessions=6, linger_s=0.02))
+    for cid in ("alpha", "beta"):
+        svc.admit(
+            cid, [k.clone() for k in base], test_config,
+            SLO(arrival_rate_hz=0.5),
+        )
+    yield svc
+    svc.stop()
+
+
+def test_service_end_to_end(small_service):
+    svc = small_service
+    svc.start()
+    sids = [svc.submit("alpha"), svc.submit("beta"), svc.submit("alpha")]
+    assert svc.drain(timeout=180)
+    for sid in sids:
+        s = svc.wait(sid, timeout=1)
+        assert s.state == "done", s.error
+        assert s.finalized_at >= s.quorum_at >= s.started_at > 0
+    st = svc.stats()
+    assert st["sessions_done"] == 3 and st["sessions_aborted"] == 0
+    assert st["inflight"] == 0
+    # two sessions for "alpha" serialized on one committee: both epochs
+    # landed, so the committee advanced twice
+    assert svc._committees["alpha"].epochs == 2
+    # serving metrics materialized in the registry
+    from fsdkr_tpu.serving import metrics as sm
+
+    assert sm.sessions_counter().value(outcome="done") >= 3
+    snap = sm.phase_histogram().snapshot_values()
+    phases = {v["labels"]["phase"] for v in snap}
+    assert {"queue", "distribute", "stream", "finalize", "total"} <= phases
+
+
+def test_service_single_shot_arm(small_service, monkeypatch):
+    """FSDKR_SERVE=0: submit() is synchronous barrier collect — no
+    service threads involved, same outcome surface."""
+    monkeypatch.setenv("FSDKR_SERVE", "0")
+    svc = small_service  # not started: the single-shot arm needs no threads
+    sid = svc.submit("alpha")
+    s = svc.wait(sid, timeout=0)
+    assert s.state == "done", s.error
+    assert svc.stats()["sessions_done"] == 1
+
+
+def test_service_admission_guards(small_service):
+    svc = small_service
+    with pytest.raises(ValueError):
+        svc.admit("alpha", [], None)
+    with pytest.raises(KeyError):
+        svc.submit("nope")
+    svc.evict("beta")
+    with pytest.raises(KeyError):
+        svc.submit("beta")
+    assert precompute.target_keys(owner=serve_owner("beta")) == []

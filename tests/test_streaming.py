@@ -1,0 +1,239 @@
+"""Streaming-vs-barrier collect equivalence (ISSUE 9, tier-1).
+
+The contract under test: `StreamingCollect` (offer messages in ANY
+arrival order, with duplicates and late deliveries) produces verdicts,
+identifiable-abort blame, and LocalKey mutations bit-identical to
+barrier `collect` on the canonical message list — honest and tampered,
+at n=3 and n=16 — and fused `finalize_streams` batches behave like
+fused barrier `collect_sessions`.
+"""
+
+import copy
+import dataclasses
+import random
+
+import pytest
+
+from fsdkr_tpu.errors import (
+    PDLwSlackProofError,
+    RangeProofError,
+    RingPedersenProofError,
+    SizeMismatchError,
+)
+from fsdkr_tpu.protocol import RefreshMessage, finalize_streams, simulate_keygen
+
+
+def _err_key(e):
+    return (type(e).__name__, tuple(map(str, getattr(e, "args", ()))))
+
+
+def _barrier_err(msgs, key, dk, config):
+    try:
+        RefreshMessage.collect(msgs, key, dk, (), config)
+        return None
+    except Exception as e:
+        return _err_key(e)
+
+
+def _stream_err(msgs, key, dk, config, seed=0):
+    st = RefreshMessage.collect_stream(
+        key, dk, [m.party_index for m in msgs], (), config
+    )
+    order = list(msgs)
+    random.Random(seed).shuffle(order)
+    for m in order:
+        assert st.offer(m) == "accepted"
+    try:
+        st.finalize()
+        return None
+    except Exception as e:
+        return _err_key(e)
+
+
+def _assert_keys_equal(a, b):
+    assert a.keys_linear.x_i.to_int() == b.keys_linear.x_i.to_int()
+    assert a.pk_vec == b.pk_vec
+    assert [ek.n for ek in a.paillier_key_vec] == [
+        ek.n for ek in b.paillier_key_vec
+    ]
+    assert a.paillier_dk.p == b.paillier_dk.p
+    assert a.paillier_dk.q == b.paillier_dk.q
+
+
+def test_streaming_honest_identical_state(one_refresh_round, test_config):
+    """Honest round: shuffled streaming arrival rotates the key to the
+    exact state barrier collect produces."""
+    keys, msgs, dks = one_refresh_round
+    kb, ks = keys[0].clone(), keys[0].clone()
+    RefreshMessage.collect(msgs, kb, dks[0], (), test_config)
+    assert _stream_err(msgs, ks, dks[0], test_config, seed=11) is None
+    _assert_keys_equal(kb, ks)
+
+
+def test_streaming_offer_statuses(one_refresh_round, test_config):
+    """Duplicate, late, and unexpected arrivals are classified and
+    ignored without changing the verdict."""
+    keys, msgs, dks = one_refresh_round
+    key = keys[1].clone()
+    st = RefreshMessage.collect_stream(
+        key, dks[1], [m.party_index for m in msgs], (), test_config
+    )
+    assert st.offer(msgs[2]) == "accepted"
+    assert st.offer(msgs[2]) == "duplicate"
+    bogus = copy.deepcopy(msgs[0])
+    bogus.party_index = 99
+    assert st.offer(bogus) == "unexpected"
+    assert not st.ready and st.missing() == [1, 2]
+    assert st.offer(msgs[0]) == "accepted"
+    assert st.offer(msgs[1]) == "accepted"
+    assert st.ready
+    st.finalize()
+    assert st.done and st.error is None
+    assert st.offer(msgs[0]) == "late"
+    # idempotent finalize: replays the stored verdict, no re-adoption
+    st.finalize()
+    kb = keys[1].clone()
+    RefreshMessage.collect(msgs, kb, dks[1], (), test_config)
+    _assert_keys_equal(kb, key)
+
+
+def test_streaming_finalize_before_quorum(one_refresh_round, test_config):
+    keys, msgs, dks = one_refresh_round
+    st = RefreshMessage.collect_stream(
+        keys[0].clone(), dks[0], [m.party_index for m in msgs], (), test_config
+    )
+    st.offer(msgs[0])
+    with pytest.raises(ValueError, match="quorum"):
+        st.finalize()
+    # the session stays open: completing it afterwards works
+    st.offer(msgs[1])
+    st.offer(msgs[2])
+    st.finalize()
+    assert st.error is None
+
+
+# every tamper lands on a different verification family / phase, so the
+# replayed barrier error order is exercised end to end
+TAMPERS = [
+    (
+        "pdl_s1",
+        lambda msgs: msgs[1].pdl_proof_vec.__setitem__(
+            0,
+            dataclasses.replace(
+                msgs[1].pdl_proof_vec[0], s1=msgs[1].pdl_proof_vec[0].s1 + 1
+            ),
+        ),
+        PDLwSlackProofError,
+    ),
+    (
+        "range_s",
+        lambda msgs: msgs[1].range_proofs.__setitem__(
+            0,
+            dataclasses.replace(
+                msgs[1].range_proofs[0], s=msgs[1].range_proofs[0].s + 1
+            ),
+        ),
+        RangeProofError,
+    ),
+    (
+        "ring_pedersen_Z",
+        lambda msgs: msgs[2].ring_pedersen_proof.Z.__setitem__(
+            0, msgs[2].ring_pedersen_proof.Z[0] + 1
+        ),
+        RingPedersenProofError,
+    ),
+    (
+        "short_vector",
+        lambda msgs: msgs[2].points_encrypted_vec.pop(),
+        SizeMismatchError,
+    ),
+]
+
+
+@pytest.mark.parametrize("name,mutate,expected", TAMPERS, ids=[t[0] for t in TAMPERS])
+def test_streaming_tamper_blame_identical(
+    one_refresh_round, test_config, name, mutate, expected
+):
+    """Single-field tampers: streaming (out-of-order arrival) raises the
+    exact error instance barrier collect raises — same type, same
+    identifiable-abort attribution."""
+    keys, msgs, dks = one_refresh_round
+    bad = copy.deepcopy(msgs)
+    mutate(bad)
+    e_b = _barrier_err(copy.deepcopy(bad), keys[0].clone(), dks[0], test_config)
+    e_s = _stream_err(copy.deepcopy(bad), keys[0].clone(), dks[0], test_config, seed=5)
+    assert e_b is not None and e_b[0] == expected.__name__
+    assert e_s == e_b
+
+
+def test_finalize_streams_fused_batch(one_refresh_round, test_config):
+    """Fused finalize across sessions == fused barrier collect_sessions:
+    one healthy session and one tampered session finalized in ONE
+    launch; the tampered one gets its exact blame, the healthy one
+    adopts — failing sessions never block the others."""
+    keys, msgs, dks = one_refresh_round
+    bad = copy.deepcopy(msgs)
+    bad[0].range_proofs[1] = dataclasses.replace(
+        bad[0].range_proofs[1], s=bad[0].range_proofs[1].s + 1
+    )
+    k_good, k_bad = keys[0].clone(), keys[1].clone()
+    streams = []
+    for key, dk, mlist, seed in (
+        (k_good, dks[0], msgs, 3),
+        (k_bad, dks[1], bad, 4),
+    ):
+        st = RefreshMessage.collect_stream(
+            key, dk, [m.party_index for m in mlist], (), test_config
+        )
+        order = list(mlist)
+        random.Random(seed).shuffle(order)
+        for m in order:
+            st.offer(m)
+        streams.append(st)
+    errs = finalize_streams(streams, test_config)
+    ref = RefreshMessage.collect_sessions(
+        [
+            (msgs, keys[0].clone(), dks[0], ()),
+            (copy.deepcopy(bad), keys[1].clone(), dks[1], ()),
+        ],
+        test_config,
+    )
+    assert errs[0] is None and ref[0] is None
+    assert _err_key(errs[1]) == _err_key(ref[1])
+    assert streams[0].error is None and streams[1].error is errs[1]
+
+
+@pytest.fixture(scope="module")
+def committee16(test_config):
+    """One honest n=16 round (cached keygen; single distribute_batch
+    shared by the honest and tamper arms below)."""
+    keys = simulate_keygen(7, 16, test_config)
+    results = RefreshMessage.distribute_batch(
+        [(k.i, k) for k in keys], 16, test_config
+    )
+    return keys, [m for m, _ in results], [dk for _, dk in results]
+
+
+def test_streaming_n16_honest_identical(committee16, test_config):
+    """ISSUE 9 acceptance: honest n=16 session — streaming under
+    shuffled arrival is state-identical to barrier collect."""
+    keys, msgs, dks = committee16
+    kb, ks = keys[0].clone(), keys[0].clone()
+    RefreshMessage.collect(msgs, kb, dks[0], (), test_config)
+    assert _stream_err(msgs, ks, dks[0], test_config, seed=16) is None
+    _assert_keys_equal(kb, ks)
+
+
+def test_streaming_n16_tamper_blame_identical(committee16, test_config):
+    """ISSUE 9 acceptance: single tamper in an n=16 session — streaming
+    blame (through the RLC fold + bisection) is bit-identical to
+    barrier."""
+    keys, msgs, dks = committee16
+    bad = copy.deepcopy(msgs)
+    bad[5].range_proofs[3] = dataclasses.replace(
+        bad[5].range_proofs[3], s=bad[5].range_proofs[3].s + 1
+    )
+    e_b = _barrier_err(copy.deepcopy(bad), keys[0].clone(), dks[0], test_config)
+    e_s = _stream_err(copy.deepcopy(bad), keys[0].clone(), dks[0], test_config, seed=61)
+    assert e_b is not None and e_b[0] == "RangeProofError"
+    assert e_s == e_b
