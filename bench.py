@@ -236,6 +236,28 @@ def precompute_fields():
     }
 
 
+def mem_fields():
+    """The memory-plan stat block (ISSUE 10, fsdkr_tpu.backend.memplan):
+    the active FSDKR_MEM_BUDGET_MB budget, bytes staged through the
+    limb encoder, the tracked peak of live staged tile bytes, the
+    kernel's VmHWM ground truth, and how many tiles the streaming
+    verification plan executed (0 = every batch fit its budget in one
+    tile). Windowed alongside the rlc block (memplan_stats_reset before
+    each measured section), except rss_peak_bytes, which is the
+    process-lifetime VmHWM by kernel semantics. Per-family tile detail
+    (rows/tile, plans) is in the telemetry snapshot's fsdkr_mem_*
+    metrics."""
+    from fsdkr_tpu.backend import memplan
+
+    return {"mem": memplan.mem_stats()}
+
+
+def memplan_stats_reset():
+    from fsdkr_tpu.backend import memplan
+
+    memplan.stats_reset()
+
+
 def rlc_fields():
     """Fold statistics of the cross-proof randomized batch verifier
     (FSDKR_RLC, fsdkr_tpu.backend.rlc), accumulated since the caller's
@@ -356,6 +378,7 @@ def bench_sessions(sessions_count, n, t, bits, m_sec):
 
     get_tracer().reset(keep_spans=True)
     rlc.stats_reset()
+    memplan_stats_reset()
     t_warm = run()
     total_proofs = proofs_per_session * sessions_count
     log(
@@ -381,6 +404,7 @@ def bench_sessions(sessions_count, n, t, bits, m_sec):
                if os.environ.get("BENCH_DEGRADED") else {}),
             "mesh": mesh_shape,
             **rlc_fields(),
+            **mem_fields(),
             **precompute_fields(),
             **roofline_fields(t_warm),
             **telemetry_fields(),
@@ -441,6 +465,7 @@ def bench_join(n, t, bits, m_sec, joins):
 
     get_tracer().reset(keep_spans=True)
     rlc.stats_reset()
+    memplan_stats_reset()
     t0 = time.time()
     RefreshMessage.collect(msgs, keys[1].clone(), dks[1], join_messages, tpu_cfg)
     t_warm = time.time() - t0
@@ -458,6 +483,7 @@ def bench_join(n, t, bits, m_sec, joins):
             "collect_cold_s": round(t_cold, 2),
             "replace_s": round(t_replace, 2),
             **rlc_fields(),
+            **mem_fields(),
             **precompute_fields(),
             "device_ec": tpu_cfg.device_ec,
             "device_powm": tpu_cfg.device_powm,
@@ -673,6 +699,7 @@ def main():
     cache_cold = powm_cache_stats()
     get_tracer().reset(keep_spans=True)
     rlc.stats_reset()
+    memplan_stats_reset()
     t0 = time.time()
     RefreshMessage.collect(msgs, keys[1].clone(), dks[1], (), tpu_cfg)
     t_tpu = time.time() - t0
@@ -758,8 +785,14 @@ def main():
 
     host = HostBatchVerifier()
     key = keys[2 % n]
-    # >= 25% of the n^2 (sender, receiver) pair loop
+    # >= 25% of the n^2 (sender, receiver) pair loop; BENCH_HOST_PAIRS
+    # caps the subsample for the large full-width shapes (n=64/n=256),
+    # where the serial CPython arm alone would otherwise dominate the
+    # step's wall-clock — the extrapolation stays linear either way
     pair_target = max(8, (n * n) // 4)
+    hp = os.environ.get("BENCH_HOST_PAIRS")
+    if hp:
+        pair_target = max(8, min(pair_target, int(hp)))
     pdl_items, range_items = [], []
     for msg in msgs:
         for i in range(n):
@@ -885,6 +918,10 @@ def main():
         # (FSDKR_RLC): fullwidth_ladders must read O(rlc_groups), not
         # O(rows_folded), and bisect_fallbacks 0 on honest transcripts
         **rlc_out,
+        # the memory-plan block (ISSUE 10): budget, staged/peak bytes,
+        # VmHWM, tiles executed — `tiles` > 0 means the streaming
+        # verification plan actually cut this workload
+        **mem_fields(),
         **trace_ab,
         # the unified registry snapshot (schema-versioned): per-phase
         # latency percentiles, pool/producer gauges, subsystem counters

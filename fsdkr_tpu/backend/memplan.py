@@ -1,0 +1,351 @@
+"""Bytes-budgeted streaming verification plan (ISSUE 10).
+
+The monolithic collect() path gathers every pair row of a batch, stages
+all of them (limb widening, Montgomery entry, fold buffers, per-row
+intermediate columns), verifies, and only then lets the staged data die.
+At the north-star shape (n=256, 2048-bit Paillier, M=256) the pair rows
+are 4096-bit and the all-rows-resident plan peaks well past a gigabyte
+of staged operands — the same wall hardware ZKP pipelines hit on-chip,
+and solve with tiled operand movement under an explicit budget (SZKP,
+arXiv:2408.05890). This module is the host-side version of that
+discipline:
+
+- `plan_rows` cuts a row axis into tiles sized so that the staged bytes
+  of the tiles in flight stay under `FSDKR_MEM_BUDGET_MB`. Tile sizes
+  are derived ONLY from public quantities — row counts and the batch's
+  bucketed width class (`pair_row_bytes`) — so the plan can never leak
+  secret-dependent structure (SECURITY.md "Memory plan discipline").
+  With a device mesh active, tiles are cut mesh-aligned via
+  `shard_kernels.tile_rows_for_mesh` so no tile falls off the sharded
+  path.
+- The stage/release tracker accounts the live staged-tile bytes and
+  exports `fsdkr_mem_*` gauges (peak resident, cumulative bytes staged,
+  tiles/tile-rows per family) that land in every bench JSON through the
+  telemetry snapshot.
+- `streamed_rows` runs a row-local verdict call tile by tile under the
+  plan (the Feldman/EC columns of collect ride this).
+
+The consumer of the pair plan is `tpu_verifier.TpuBatchVerifier`
+(`_verify_pairs_streamed`): build -> widen/stage -> verify -> wipe per
+tile, with the cross-proof RLC folds accumulated as running per-group
+partial products (`backend.rlc.StreamFold`) so the combined checks never
+need all rows live. `FSDKR_MEM_PLAN=0` restores the monolithic path for
+A/B isolation; verdicts and identifiable-abort blame are bit-identical
+at every budget (tests/test_memplan.py).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+__all__ = [
+    "memplan_enabled",
+    "mem_budget_bytes",
+    "pair_row_bytes",
+    "ec_row_bytes",
+    "TilePlan",
+    "plan_rows",
+    "stage",
+    "release",
+    "streamed_rows",
+    "mem_stats",
+    "stats_reset",
+    "vmhwm_bytes",
+]
+
+_OFF = ("0", "off", "false", "no")
+
+# Per-row staged-bytes estimate for one pair row (PDL + Alice range
+# verified together). Engineering estimate covering, per row: the staged
+# limb copies of the modexp columns (u32 limbs are 2x the value bytes),
+# the per-row intermediate integer columns of both families (u/w parts,
+# base inversions, fold aggregates), and engine scratch. Derived from
+# PUBLIC width buckets only — never from wire values.
+_PAIR_ROW_FACTOR = 16
+_PAIR_ROW_BASE = 512  # EC points, object headers, span bookkeeping
+
+
+def memplan_enabled() -> bool:
+    """FSDKR_MEM_PLAN gates the bytes-budgeted streaming verification
+    plan (default on): =0 restores the all-rows-resident monolithic
+    gather/stage/verify sequence for A/B isolation. Read at call time so
+    the bench battery and CI legs can toggle it per step."""
+    return os.environ.get("FSDKR_MEM_PLAN", "1").lower() not in _OFF
+
+
+def mem_budget_bytes() -> int:
+    """The staged-bytes budget from FSDKR_MEM_BUDGET_MB (float MB;
+    default 256). The planner sizes tiles so the tiles concurrently in
+    flight (two under the double-buffered pipeline) fit the budget; a
+    budget below one row's estimate degrades to 1-row tiles — the plan
+    never refuses to run."""
+    try:
+        mb = float(os.environ.get("FSDKR_MEM_BUDGET_MB", "256"))
+    except ValueError:
+        mb = 256.0
+    return max(1, int(mb * (1 << 20)))
+
+
+def pair_row_bytes(nn_bits: int, nt_bits: int) -> int:
+    """Staged-bytes estimate for one pair row at the batch's PUBLIC
+    width bucket (mod-n^2 and mod-N~ widths rounded up the limb
+    ladder). Width-bucketed by construction: every row of a collect
+    shares the config's width class, so one estimate prices the whole
+    batch and the tile cut depends only on (row count, width bucket)."""
+    from ..ops.limbs import LIMB_BITS, limbs_for_bits
+
+    nn_b = limbs_for_bits(max(1, nn_bits)) * (LIMB_BITS // 8)
+    nt_b = limbs_for_bits(max(1, nt_bits)) * (LIMB_BITS // 8)
+    return _PAIR_ROW_FACTOR * (nn_b + nt_b) + _PAIR_ROW_BASE
+
+
+def ec_row_bytes() -> int:
+    """Staged-bytes estimate for one Feldman/EC row (points, scalars,
+    MSM staging; curve width is fixed)."""
+    return 1024
+
+
+@dataclass(frozen=True)
+class TilePlan:
+    """One planned tiling of a row axis. `tiles` are [lo, hi) spans;
+    `inflight` is how many tiles the streaming driver may hold staged at
+    once (the budget divides by it)."""
+
+    rows: int
+    row_bytes: int
+    budget: int
+    inflight: int
+    tile_rows: int
+    tiles: Tuple[Tuple[int, int], ...]
+
+    def tile_bytes(self, rows: int) -> int:
+        return rows * self.row_bytes
+
+    @property
+    def multi_tile(self) -> bool:
+        return len(self.tiles) > 1
+
+
+def plan_rows(
+    rows: int, row_bytes: int, label: str = "pairs"
+) -> Optional[TilePlan]:
+    """Cut `rows` into tiles whose in-flight staged bytes fit the
+    budget. Returns None when the plan is disabled or there is nothing
+    to cut. Tile sizes are floored at one row (a starvation budget
+    degrades, never refuses) and rounded to the active mesh's device
+    count via tile_rows_for_mesh so cut tiles stay on the sharded
+    path."""
+    if rows <= 0 or row_bytes <= 0 or not memplan_enabled():
+        return None
+    from ..utils.pipeline import pipeline_enabled
+
+    budget = mem_budget_bytes()
+    inflight = 2 if pipeline_enabled() else 1
+    tile = max(1, budget // max(1, row_bytes * inflight))
+    if tile < rows:
+        from .powm import active_mesh
+
+        mesh = active_mesh()
+        if mesh is not None:
+            from ..parallel.shard_kernels import tile_rows_for_mesh
+
+            tile = tile_rows_for_mesh(tile, mesh)
+    tile = min(tile, rows)
+    tiles = tuple(
+        (lo, min(lo + tile, rows)) for lo in range(0, rows, tile)
+    )
+    _record_plan(label, rows, budget, tile, len(tiles))
+    return TilePlan(
+        rows=rows,
+        row_bytes=row_bytes,
+        budget=budget,
+        inflight=inflight,
+        tile_rows=tile,
+        tiles=tiles,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: the fsdkr_mem_* family. Gauges describe the latest plan and
+# the staged-bytes high-water mark; counters accumulate across the
+# measurement window (bench.py embeds the registry snapshot in every
+# bench JSON, so these are stamped into every report).
+
+
+def _plan_gauges():
+    from ..telemetry import registry
+
+    return (
+        registry.gauge(
+            "fsdkr_mem_budget_bytes",
+            "staged-bytes budget of the streaming verification plan "
+            "(FSDKR_MEM_BUDGET_MB)",
+        ),
+        registry.gauge(
+            "fsdkr_mem_tile_rows",
+            "rows per tile of the latest memory plan",
+            labelnames=("family",),
+        ),
+        registry.gauge(
+            "fsdkr_mem_plan_rows",
+            "total rows of the latest memory plan",
+            labelnames=("family",),
+        ),
+        registry.counter(
+            "fsdkr_mem_tiles",
+            "tiles executed by the streaming verification plan",
+            labelnames=("family",),
+        ),
+        registry.counter(
+            "fsdkr_mem_plans",
+            "memory plans computed (multi=1 rows that needed >1 tile)",
+            labelnames=("family", "multi"),
+        ),
+    )
+
+
+def _record_plan(label, rows, budget, tile, n_tiles) -> None:
+    budget_g, tile_g, rows_g, _tiles_c, plans_c = _plan_gauges()
+    budget_g.set(budget)
+    tile_g.set(tile, family=label)
+    rows_g.set(rows, family=label)
+    plans_c.inc(1, family=label, multi=(n_tiles > 1))
+
+
+def count_tile(label: str) -> None:
+    _plan_gauges()[3].inc(1, family=label)
+
+
+class _StageTracker:
+    """Live staged-tile bytes with a high-water mark. Single process-
+    wide instance: the streaming drivers stage() a tile's estimated
+    bytes before building it and release() after the verify+wipe, so
+    the peak gauge is the enforceable reading the budget tests assert
+    against."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.current = 0
+        self.peak = 0
+
+    def stage(self, nbytes: int) -> None:
+        with self._lock:
+            self.current += nbytes
+            if self.current > self.peak:
+                self.peak = self.current
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self.current = max(0, self.current - nbytes)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.current = 0
+            self.peak = 0
+
+
+_TRACKER = _StageTracker()
+
+
+def _tracker_gauges():
+    from ..telemetry import registry
+
+    peak = registry.gauge(
+        "fsdkr_mem_peak_resident_bytes",
+        "high-water mark of live staged tile bytes (memory-plan "
+        "estimate, stage/release accounted)",
+    )
+    peak.set_function(lambda: float(_TRACKER.peak))
+    return peak, registry.install_rss_gauge()
+
+
+def stage(nbytes: int) -> None:
+    """Account a tile's estimated staged bytes as live (call before
+    building/widening the tile)."""
+    _tracker_gauges()
+    _TRACKER.stage(nbytes)
+
+
+def release(nbytes: int) -> None:
+    """Release a tile's accounted bytes (call after verify + wipe)."""
+    _TRACKER.release(nbytes)
+
+
+def staged_peak_bytes() -> int:
+    return _TRACKER.peak
+
+
+def vmhwm_bytes() -> int:
+    """Process peak RSS in bytes (telemetry.registry.vmhwm_bytes — the
+    canonical reader; re-exported here so the memory-plan consumers and
+    the `mem` bench block share one implementation)."""
+    from ..telemetry.registry import vmhwm_bytes as _v
+
+    return _v()
+
+
+def mem_stats() -> dict:
+    """The `mem` stat block of a bench JSON: the active budget, the
+    cumulative staged-bytes counter, the tracked peak-resident estimate,
+    and the process VmHWM ground truth. Tile/plan details live in the
+    labeled fsdkr_mem_* metrics of the embedded telemetry snapshot."""
+    from ..telemetry import registry
+
+    _tracker_gauges()
+    staged = registry.counter(
+        "fsdkr_mem_bytes_staged",
+        "cumulative bytes staged through the limb encoder",
+    )
+    tiles = _plan_gauges()[3]
+    return {
+        "plan_enabled": memplan_enabled(),
+        "budget_bytes": mem_budget_bytes(),
+        "bytes_staged": int(staged.total()),
+        "peak_resident_bytes": int(_TRACKER.peak),
+        "rss_peak_bytes": vmhwm_bytes(),
+        "tiles": int(tiles.total()),
+    }
+
+
+def stats_reset() -> None:
+    """Zero the stage tracker AND the cumulative tile/bytes counters
+    for a fresh measurement window — the same windowing contract as
+    rlc.stats_reset (bench.py calls both before each measured section,
+    so a record's `mem` block describes that section, not the whole
+    process). Plan gauges keep their readings (point-in-time state)."""
+    _TRACKER.reset()
+    from ..telemetry import registry
+
+    registry.get_registry().reset_window(
+        names=("fsdkr_mem_tiles", "fsdkr_mem_bytes_staged",
+               "fsdkr_mem_plans")
+    )
+
+
+# ---------------------------------------------------------------------------
+
+
+def streamed_rows(call, items: Sequence, row_bytes: int, label: str) -> List:
+    """Run a ROW-LOCAL verdict call tile by tile under the memory plan
+    and concatenate. Row-local means each row's verdict is a function of
+    that row alone (any internal batching — e.g. validate_feldman's
+    per-scheme RLC combine — must fall back to exact per-row checks on
+    failure, which every backend batcher here does), so cutting the row
+    axis cannot change any verdict. Single-tile plans call through
+    unchanged."""
+    plan = plan_rows(len(items), row_bytes, label=label)
+    if plan is None or not plan.multi_tile:
+        return call(items)
+    out: List = []
+    for lo, hi in plan.tiles:
+        nbytes = plan.tile_bytes(hi - lo)
+        stage(nbytes)
+        try:
+            count_tile(label)
+            out.extend(call(items[lo:hi]))
+        finally:
+            release(nbytes)
+    return out

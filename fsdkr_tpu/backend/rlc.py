@@ -50,6 +50,7 @@ __all__ = [
     "rlc_enabled",
     "sample_rhos",
     "bisect_rows",
+    "StreamFold",
     "stats",
     "stats_reset",
     "count",
@@ -87,6 +88,7 @@ def sample_rhos(count: int) -> List[int]:
 
 _EVENTS = (
     "rlc_groups", "rows_folded", "fullwidth_ladders", "bisect_fallbacks",
+    "stream_tiles",
 )
 
 
@@ -114,6 +116,46 @@ def stats_reset() -> None:
 
 
 # ---------------------------------------------------------------------------
+
+
+class StreamFold:
+    """Running partial state of one RLC group folded across streaming
+    tiles (the memory-plan path, backend.memplan): the combined check
+
+        prod_i lhs_i^{rho_i} == (shared bases)^{merged exponents} ...
+
+    factorizes over any partition of the rows — prod_tiles prod_{i in
+    tile} x_i^{rho_i} — so a tile only ever contributes (a) its partial
+    products over the per-row bases (evaluated on the tile's short
+    aggregated chains and multiplied in here) and (b) plain integer
+    sums of its merged shared-base exponents. The full-width ladders
+    raising the shared bases to the merged exponents run ONCE per group
+    at finish, so the O(1)-full-width-ladders-per-group property of the
+    monolithic fold is preserved at every budget, while no tile's
+    staged rows outlive its own verify step.
+
+    `prods` are the running per-row-base partial products (one slot per
+    aggregated chain the family folds: PDL mod-N~ uses 1, mod-n^2 uses
+    2); `exp_sums` the running merged-exponent integer sums; `rows` the
+    absorbed global row indices, in absorption order, for the bisection
+    fallback (which re-folds from the retained row data exactly like
+    the monolithic path — blame semantics are shared code)."""
+
+    __slots__ = ("modulus", "prods", "exp_sums", "rows")
+
+    def __init__(self, modulus: int, n_prods: int = 1, n_exps: int = 0):
+        self.modulus = modulus
+        self.prods = [1] * n_prods
+        self.exp_sums = [0] * n_exps
+        self.rows: List[int] = []
+
+    def absorb(self, prod_vals, exp_vals=(), rows=()) -> None:
+        m = self.modulus
+        for i, v in enumerate(prod_vals):
+            self.prods[i] = self.prods[i] * v % m
+        for i, e in enumerate(exp_vals):
+            self.exp_sums[i] += e
+        self.rows.extend(rows)
 
 
 def bisect_rows(

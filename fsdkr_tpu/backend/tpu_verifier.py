@@ -252,8 +252,8 @@ class TpuBatchVerifier(BatchVerifier):
         for i, ((p, st), ok) in enumerate(zip(items, row_ok)):
             if not ok:
                 continue
-            nt_groups.setdefault((st.h1, st.h2, st.N_tilde), []).append(i)
-            nn_groups.setdefault((st.ek.n, st.ek.nn), []).append(i)
+            nt_groups.setdefault(self._pdl_nt_key(st), []).append(i)
+            nn_groups.setdefault(self._pdl_nn_key(st), []).append(i)
 
         mb: list = []
         me: list = []
@@ -261,11 +261,7 @@ class TpuBatchVerifier(BatchVerifier):
         nt_plan = []  # (row indices, lhs position, rhs position)
         for (h1, h2, nt), idxs in nt_groups.items():
             rho = rlc.sample_rhos(len(idxs))
-            rows = [
-                (items[i][0].z, items[i][0].u3, e_vec[i],
-                 items[i][0].s1, items[i][0].s3)
-                for i in idxs
-            ]
+            rows = self._pdl_nt_rows(items, e_vec, idxs)
             lhs, rhs = PDLwSlackProof.rlc_fold_nt(h1, h2, nt, rows, rho)
             nt_plan.append((idxs, len(mm), len(mm) + 1))
             for b, e, m in (lhs, rhs):
@@ -275,11 +271,7 @@ class TpuBatchVerifier(BatchVerifier):
         nn_plan = []  # (row indices, n, nn, gs1, s2 position, commit position)
         for (n, nn), idxs in nn_groups.items():
             rho = rlc.sample_rhos(len(idxs))
-            rows = [
-                (items[i][0].u2, items[i][1].ciphertext, e_vec[i],
-                 items[i][0].s1, items[i][0].s2)
-                for i in idxs
-            ]
+            rows = self._pdl_nn_rows(items, e_vec, idxs)
             s2_row, commit_row, gs1 = PDLwSlackProof.rlc_fold_nn(
                 n, nn, rows, rho
             )
@@ -328,14 +320,104 @@ class TpuBatchVerifier(BatchVerifier):
         rhs = gs1 * intops.mod_pow(p.s2 % nn, n, nn) % nn
         return lhs == rhs
 
-    def _pdl_rlc_finish(self, items, state, results, u1_vec=None):
-        """Compare each group's folded equation, bisect failing groups
-        down to exact per-row verdicts (backend.rlc.bisect_rows), and
-        assemble the same (u1, u2, u3) triples as _pdl_finish."""
+    # -- shared fold-input construction + bisection blame resolution
+    # (monolithic AND streamed RLC paths — keeping the group keys, the
+    # fold row layouts, the subset re-folds, and the exact leaf checks
+    # in ONE set of helpers is what makes memory-planned-vs-monolithic
+    # verdict/blame identity a structural property; see
+    # _verify_pairs_streamed)
+
+    @staticmethod
+    def _pdl_nt_key(st):
+        return (st.h1, st.h2, st.N_tilde)
+
+    @staticmethod
+    def _pdl_nn_key(st):
+        return (st.ek.n, st.ek.nn)
+
+    @staticmethod
+    def _pdl_nt_rows(items, e_vec, idxs):
+        """rlc_fold_nt's row layout: (z, u3, e, s1, s3) per row."""
+        return [
+            (items[i][0].z, items[i][0].u3, e_vec[i],
+             items[i][0].s1, items[i][0].s3)
+            for i in idxs
+        ]
+
+    @staticmethod
+    def _pdl_nn_rows(items, e_vec, idxs):
+        """rlc_fold_nn's row layout: (u2, c, e, s1, s2) per row."""
+        return [
+            (items[i][0].u2, items[i][1].ciphertext, e_vec[i],
+             items[i][0].s1, items[i][0].s2)
+            for i in idxs
+        ]
+
+    def _pdl_nt_subset_check(self, items, e_vec, h1, h2, nt, sub) -> bool:
+        """Fresh-rho combined mod-N~ check over an arbitrary row subset
+        (bisection node). Host engines: a bisection is the rare
+        adversarial path, never the throughput path."""
+        from . import rlc
+        from .powm import multi_powm
+
+        rho = rlc.sample_rhos(len(sub))
+        rows = self._pdl_nt_rows(items, e_vec, sub)
+        lhs, rhs = PDLwSlackProof.rlc_fold_nt(h1, h2, nt, rows, rho)
+        va, vb = multi_powm(
+            [lhs[0], rhs[0]], [lhs[1], rhs[1]], [nt, nt], device=False,
+        )
+        return va == vb
+
+    def _pdl_nn_subset_check(self, items, e_vec, n, nn, sub) -> bool:
+        """Fresh-rho combined mod-n^2 check over an arbitrary row
+        subset (bisection node)."""
         from ..core import intops
         from . import rlc
         from .powm import multi_powm
 
+        rho = rlc.sample_rhos(len(sub))
+        rows = self._pdl_nn_rows(items, e_vec, sub)
+        s2_row, commit_row, g1 = PDLwSlackProof.rlc_fold_nn(n, nn, rows, rho)
+        av, cv = multi_powm(
+            [s2_row[0], commit_row[0]],
+            [s2_row[1], commit_row[1]],
+            [nn, nn],
+            device=False,
+        )
+        return cv == g1 * intops.mod_pow(av, n, nn) % nn
+
+    def _pdl_nt_bisect(self, items, e_vec, h1, h2, nt, idxs, ok3_vec):
+        from . import rlc
+
+        rlc.count("bisect_fallbacks")
+        verdicts = rlc.bisect_rows(
+            idxs,
+            lambda sub: self._pdl_nt_subset_check(
+                items, e_vec, h1, h2, nt, sub
+            ),
+            lambda i: self._pdl_eq3_exact(items, e_vec, i),
+        )
+        for i, v in verdicts.items():
+            ok3_vec[i] = v
+
+    def _pdl_nn_bisect(self, items, e_vec, n, nn, idxs, ok2_vec):
+        from . import rlc
+
+        rlc.count("bisect_fallbacks")
+        verdicts = rlc.bisect_rows(
+            idxs,
+            lambda sub: self._pdl_nn_subset_check(
+                items, e_vec, n, nn, sub
+            ),
+            lambda i: self._pdl_eq2_exact(items, e_vec, i),
+        )
+        for i, v in verdicts.items():
+            ok2_vec[i] = v
+
+    def _pdl_rlc_finish(self, items, state, results, u1_vec=None):
+        """Compare each group's folded equation, bisect failing groups
+        down to exact per-row verdicts (backend.rlc.bisect_rows), and
+        assemble the same (u1, u2, u3) triples as _pdl_finish."""
         e_vec, row_ok, nt_plan, nn_plan = state
         multi_res = results[0]
         ok2_vec = [False] * len(items)
@@ -347,35 +429,10 @@ class TpuBatchVerifier(BatchVerifier):
                     for i in idxs:
                         ok3_vec[i] = True
                     continue
-                rlc.count("bisect_fallbacks")
-                h1, h2, nt = (
-                    items[idxs[0]][1].h1,
-                    items[idxs[0]][1].h2,
-                    items[idxs[0]][1].N_tilde,
+                st0 = items[idxs[0]][1]
+                self._pdl_nt_bisect(
+                    items, e_vec, st0.h1, st0.h2, st0.N_tilde, idxs, ok3_vec
                 )
-
-                def check(sub, h1=h1, h2=h2, nt=nt):
-                    rho = rlc.sample_rhos(len(sub))
-                    rows = [
-                        (items[i][0].z, items[i][0].u3, e_vec[i],
-                         items[i][0].s1, items[i][0].s3)
-                        for i in sub
-                    ]
-                    lhs, rhs = PDLwSlackProof.rlc_fold_nt(
-                        h1, h2, nt, rows, rho
-                    )
-                    va, vb = multi_powm(
-                        [lhs[0], rhs[0]], [lhs[1], rhs[1]], [nt, nt],
-                        device=False,
-                    )
-                    return va == vb
-
-                verdicts = rlc.bisect_rows(
-                    idxs, check,
-                    lambda i: self._pdl_eq3_exact(items, e_vec, i),
-                )
-                for i, v in verdicts.items():
-                    ok3_vec[i] = v
 
         with phase("pdl.rlc_eq2", items=sum(len(g[0]) for g in nn_plan)):
             # phase 2: every group's s2-aggregate to the n-th power in
@@ -392,32 +449,7 @@ class TpuBatchVerifier(BatchVerifier):
                     for i in idxs:
                         ok2_vec[i] = True
                     continue
-                rlc.count("bisect_fallbacks")
-
-                def check(sub, n=n, nn=nn):
-                    rho = rlc.sample_rhos(len(sub))
-                    rows = [
-                        (items[i][0].u2, items[i][1].ciphertext, e_vec[i],
-                         items[i][0].s1, items[i][0].s2)
-                        for i in sub
-                    ]
-                    s2_row, commit_row, g1 = PDLwSlackProof.rlc_fold_nn(
-                        n, nn, rows, rho
-                    )
-                    av, cv = multi_powm(
-                        [s2_row[0], commit_row[0]],
-                        [s2_row[1], commit_row[1]],
-                        [nn, nn],
-                        device=False,
-                    )
-                    return cv == g1 * intops.mod_pow(av, n, nn) % nn
-
-                verdicts = rlc.bisect_rows(
-                    idxs, check,
-                    lambda i: self._pdl_eq2_exact(items, e_vec, i),
-                )
-                for i, v in verdicts.items():
-                    ok2_vec[i] = v
+                self._pdl_nn_bisect(items, e_vec, n, nn, idxs, ok2_vec)
 
         with phase("pdl.ec_u1", items=len(items)):
             ok1_vec = (
@@ -868,6 +900,289 @@ class TpuBatchVerifier(BatchVerifier):
         return self._range_finish(items, mods, results)
 
     def verify_pairs(self, pdl_items, range_items):
+        """Both pair-loop families of a collect. Dispatch:
+
+        - Under the bytes-budgeted memory plan (FSDKR_MEM_PLAN, default
+          on) a batch whose estimated staged bytes exceed
+          FSDKR_MEM_BUDGET_MB runs tile-by-tile through
+          `_verify_pairs_streamed` — build/stage/verify/wipe per tile,
+          RLC folds accumulated as running per-group partial products —
+          so resident staged data is O(tile), not O(rows).
+        - Batches that fit the budget (and the FSDKR_MEM_PLAN=0 arm)
+          take the monolithic single-launch-set path unchanged.
+
+        Verdicts and identifiable-abort blame are bit-identical between
+        the two (tests/test_memplan.py, every budget down to 1-row
+        tiles)."""
+        if not pdl_items or not range_items:
+            return super().verify_pairs(pdl_items, range_items)
+        if len(pdl_items) == len(range_items):
+            # the streamed driver slices BOTH families with one row
+            # axis; unequal lists (not produced by any collect path,
+            # but allowed by the base contract) stay monolithic
+            plan = self._pair_plan(pdl_items)
+            if plan is not None and plan.multi_tile:
+                return self._verify_pairs_streamed(
+                    pdl_items, range_items, plan
+                )
+        return self._verify_pairs_monolithic(pdl_items, range_items)
+
+    def _pair_plan(self, pdl_items):
+        """Tile plan for a pair batch. The widths feeding the row-bytes
+        estimate come from the RECEIVER's own key vectors (ek.nn, N~) —
+        verifier-local public values, so the tile cut depends only on
+        public row counts and width buckets (SECURITY.md "Memory plan
+        discipline"); adversarial wire fields cannot shape it."""
+        from . import memplan
+
+        if not memplan.memplan_enabled():
+            return None
+        nn_bits = max(st.ek.nn.bit_length() for _, st in pdl_items)
+        nt_bits = max(st.N_tilde.bit_length() for _, st in pdl_items)
+        return memplan.plan_rows(
+            len(pdl_items),
+            memplan.pair_row_bytes(nn_bits, nt_bits),
+            label="pairs",
+        )
+
+    def _verify_pairs_streamed(self, pdl_items, range_items, plan):
+        """Memory-planned pair verification: the row axis runs as
+        budget-sized tiles (mesh-aligned cuts, backend.memplan), each
+        tile built -> staged -> verified -> wiped before the next is
+        admitted, with tile k+1's host staging (gates, Fiat-Shamir
+        hashing) prefetched behind tile k's engine time
+        (utils.pipeline.prefetch_tiles — at most two tiles in flight,
+        the planner's `inflight` factor).
+
+        Row-local work (the whole range family, the EC u1 column, the
+        FSDKR_RLC=0 column path) completes inside its tile. The
+        cross-proof RLC folds accumulate as running per-group partial
+        products (rlc.StreamFold): a tile contributes its short
+        aggregated chains and its merged-exponent integer sums, and the
+        O(1) full-width ladders per group run once at finish — so the
+        combined checks never need all rows live, and the fold's
+        full-width-ladder count matches the monolithic plan exactly.
+        Failing groups bisect through the SAME subset-check/exact-leaf
+        helpers as the monolithic path (blame identity is shared code,
+        not a re-implementation)."""
+        from ..utils.pipeline import prefetch_tiles, run_jobs
+        from . import memplan, rlc
+        from .powm import (
+            multi_powm,
+            multiexp_enabled,
+            powm_columns,
+            rangeopt_enabled,
+        )
+        from .rlc import rlc_enabled
+
+        rows = len(pdl_items)
+        range_out = [False] * rows
+
+        if not rlc_enabled():
+            # per-row column/joint path: verdicts are row-local, so each
+            # tile runs the monolithic path on its own slice
+            pdl_out = [None] * rows
+
+            def consume_cols(span):
+                lo, hi = span
+                nbytes = plan.tile_bytes(hi - lo)
+                memplan.stage(nbytes)
+                try:
+                    memplan.count_tile("pairs")
+                    rlc.count("stream_tiles")
+                    p_v, r_v = self._verify_pairs_monolithic(
+                        pdl_items[lo:hi], range_items[lo:hi]
+                    )
+                    pdl_out[lo:hi] = p_v
+                    range_out[lo:hi] = r_v
+                finally:
+                    memplan.release(nbytes)
+
+            with phase(
+                "pairs.stream_tiles", items=rows, tiles=len(plan.tiles)
+            ):
+                prefetch_tiles(
+                    plan.tiles, lambda lo, hi: (lo, hi), consume_cols
+                )
+            return pdl_out, range_out
+
+        e_vec = [0] * rows
+        row_ok = [False] * rows
+        ok1_vec = [False] * rows
+        nt_folds: Dict[tuple, rlc.StreamFold] = {}
+        nn_folds: Dict[tuple, rlc.StreamFold] = {}
+
+        def prepare(lo, hi):
+            # host-only staging of the NEXT tile: domain gates and
+            # Fiat-Shamir challenges (read-only over shared state)
+            tile = pdl_items[lo:hi]
+            p_ok = [PDLwSlackProof.domain_gate(p, st) for p, st in tile]
+            with phase("pdl.challenge", items=len(tile)):
+                e_tile = [
+                    PDLwSlackProof._challenge(
+                        st, p.z, p.u1, p.u2, p.u3, self.config.hash_alg
+                    )
+                    if ok
+                    else 0
+                    for (p, st), ok in zip(tile, p_ok)
+                ]
+            return lo, hi, p_ok, e_tile
+
+        def consume(prep):
+            lo, hi, p_ok, e_tile = prep
+            row_ok[lo:hi] = p_ok
+            e_vec[lo:hi] = e_tile
+            nbytes = plan.tile_bytes(hi - lo)
+            memplan.stage(nbytes)
+            try:
+                memplan.count_tile("pairs")
+                rlc.count("stream_tiles")
+                # ---- PDL: this tile's fold contributions -------------
+                nt_groups: Dict[tuple, List[int]] = {}
+                nn_groups: Dict[tuple, List[int]] = {}
+                for i in range(lo, hi):
+                    if not row_ok[i]:
+                        continue
+                    st = pdl_items[i][1]
+                    nt_groups.setdefault(self._pdl_nt_key(st), []).append(i)
+                    nn_groups.setdefault(self._pdl_nn_key(st), []).append(i)
+                mb: list = []
+                me: list = []
+                mm: list = []
+                joins = []  # (fold, result slots, exp sums, row indices)
+                for (h1, h2, nt), idxs in nt_groups.items():
+                    rho = rlc.sample_rhos(len(idxs))
+                    rows_d = self._pdl_nt_rows(pdl_items, e_vec, idxs)
+                    lhs, rhs = PDLwSlackProof.rlc_fold_nt(
+                        h1, h2, nt, rows_d, rho
+                    )
+                    fold = nt_folds.get((h1, h2, nt))
+                    if fold is None:
+                        fold = nt_folds[(h1, h2, nt)] = rlc.StreamFold(
+                            nt, n_prods=1, n_exps=2
+                        )
+                    joins.append((fold, (len(mm),), lhs[1], idxs))
+                    mb.append(rhs[0])
+                    me.append(rhs[1])
+                    mm.append(nt)
+                for (n, nn), idxs in nn_groups.items():
+                    rho = rlc.sample_rhos(len(idxs))
+                    rows_d = self._pdl_nn_rows(pdl_items, e_vec, idxs)
+                    s2_row, commit_row, gs1 = PDLwSlackProof.rlc_fold_nn(
+                        n, nn, rows_d, rho
+                    )
+                    # the tile's merged (1+n)-exponent, recovered from
+                    # the closed form: gs1 = 1 + (sum rho s1 mod n) * n
+                    s1_part = (gs1 - 1) // n
+                    fold = nn_folds.get((n, nn))
+                    if fold is None:
+                        fold = nn_folds[(n, nn)] = rlc.StreamFold(
+                            nn, n_prods=2, n_exps=1
+                        )
+                    joins.append(
+                        (fold, (len(mm), len(mm) + 1), (s1_part,), idxs)
+                    )
+                    for b, e, m in (s2_row, commit_row):
+                        mb.append(b)
+                        me.append(e)
+                        mm.append(m)
+                rlc.count(
+                    "rows_folded",
+                    sum(len(g) for g in nt_groups.values())
+                    + sum(len(g) for g in nn_groups.values()),
+                )
+                with phase("pdl.rlc_fold", items=len(mm)):
+                    res = multi_powm(mb, me, mm) if mm else []
+                for fold, slots, exps, idxs in joins:
+                    fold.absorb([res[s] for s in slots], exps, idxs)
+
+                # ---- range family: row-local, completes in-tile ------
+                r_slice = range_items[lo:hi]
+                if rangeopt_enabled():
+                    rstate = self._range_opt_prepare(r_slice)
+                    run_jobs(self._range_opt_jobs(r_slice, rstate))
+                    range_out[lo:hi] = self._range_opt_finish(
+                        r_slice, rstate
+                    )
+                else:
+                    cols, rmods = self._range_prepare(
+                        r_slice, joint=multiexp_enabled()
+                    )
+                    with phase(
+                        "range.modexp_columns",
+                        items=len(cols) * len(r_slice),
+                    ):
+                        results = powm_columns(_modexp, *cols)
+                    range_out[lo:hi] = self._range_finish(
+                        r_slice, rmods, results
+                    )
+
+                # ---- EC u1 column of the tile ------------------------
+                with phase("pdl.ec_u1", items=hi - lo):
+                    ok1_vec[lo:hi] = self._pdl_u1_batch(
+                        pdl_items[lo:hi], e_tile
+                    )
+            finally:
+                memplan.release(nbytes)
+
+        with phase("pairs.stream_tiles", items=rows, tiles=len(plan.tiles)):
+            prefetch_tiles(plan.tiles, prepare, consume)
+
+        # ---- finish: the O(1) full-width ladders per group -----------
+        ok2_vec = [False] * rows
+        ok3_vec = [False] * rows
+        rlc.count("rlc_groups", len(nt_folds) + len(nn_folds))
+        rlc.count("fullwidth_ladders", len(nt_folds) + len(nn_folds))
+        with phase(
+            "pdl.rlc_eq3",
+            items=sum(len(f.rows) for f in nt_folds.values()),
+        ):
+            groups = list(nt_folds.items())
+            if groups:
+                lhs_vals = multi_powm(
+                    [(h1, h2) for (h1, h2, _nt), _ in groups],
+                    [tuple(f.exp_sums) for _, f in groups],
+                    [nt for (_h1, _h2, nt), _ in groups],
+                )
+                for ((h1, h2, nt), fold), lv in zip(groups, lhs_vals):
+                    if lv == fold.prods[0]:
+                        for i in fold.rows:
+                            ok3_vec[i] = True
+                    else:
+                        self._pdl_nt_bisect(
+                            pdl_items, e_vec, h1, h2, nt, fold.rows,
+                            ok3_vec,
+                        )
+        with phase(
+            "pdl.rlc_eq2",
+            items=sum(len(f.rows) for f in nn_folds.values()),
+        ):
+            groups = list(nn_folds.items())
+            if groups:
+                a_pow = _modexp(
+                    [f.prods[0] for _, f in groups],
+                    [n for (n, _nn), _ in groups],
+                    [nn for (_n, nn), _ in groups],
+                )
+                for ((n, nn), fold), ap in zip(groups, a_pow):
+                    gs1 = (1 + (fold.exp_sums[0] % n) * n) % nn
+                    if fold.prods[1] == gs1 * ap % nn:
+                        for i in fold.rows:
+                            ok2_vec[i] = True
+                    else:
+                        self._pdl_nn_bisect(
+                            pdl_items, e_vec, n, nn, fold.rows, ok2_vec
+                        )
+
+        out = []
+        for idx in range(rows):
+            ok1 = ok1_vec[idx] and row_ok[idx]
+            ok2 = ok2_vec[idx]
+            ok3 = ok3_vec[idx]
+            out.append(None if (ok1 and ok2 and ok3) else (ok1, ok2, ok3))
+        return out, range_out
+
+    def _verify_pairs_monolithic(self, pdl_items, range_items):
         """Both pair-loop families through ONE fused launch set: every
         modexp column submitted together, so same-width columns across
         families share launches (e.g. both 256-bit challenge columns) —
@@ -876,8 +1191,6 @@ class TpuBatchVerifier(BatchVerifier):
         [s, c^{-1}] with exponents [n, e]). Cuts the pair loop's
         sequential launch count roughly in half, which dominates when
         small committees underfeed the chip."""
-        if not pdl_items or not range_items:
-            return super().verify_pairs(pdl_items, range_items)
         from ..utils.pipeline import run_jobs, submit_bg
         from .powm import multiexp_enabled, powm_columns, rangeopt_enabled
         from .rlc import rlc_enabled

@@ -36,6 +36,24 @@ WINDOW_BITS = 4  # fixed-window width of the modexp kernels
 # staging scales with the FSDKR_THREADS row pool.
 _NATIVE_STAGE_MIN_LIMBS = 4096
 
+# cumulative bytes-staged telemetry (ISSUE 10, fsdkr_mem_* family): one
+# counter bump per ENCODE CALL (a whole batch column), not per row — the
+# memory plan's bytes-staged accounting rides the actual staging path.
+# Cached child; telemetry is dependency-free (no jax, no native).
+_STAGED_COUNTER = None
+
+
+def _count_staged(nbytes: int) -> None:
+    global _STAGED_COUNTER
+    if _STAGED_COUNTER is None:
+        from ..telemetry import registry
+
+        _STAGED_COUNTER = registry.counter(
+            "fsdkr_mem_bytes_staged",
+            "cumulative bytes staged through the limb encoder",
+        )
+    _STAGED_COUNTER.inc(nbytes)
+
 # Exponent-width ladder: modexp wall-clock is proportional to the bucketed
 # width (sequential window loop), so the ladder is finer than powers of two
 # where the protocol's exponent sizes actually fall (q*Ntilde ~ 2304 bits,
@@ -75,6 +93,7 @@ def ints_to_limbs(xs: Sequence[int], num_limbs: int) -> np.ndarray:
     (exponents, shares, nonces); see SECURITY.md.
     """
     nbytes = num_limbs * (LIMB_BITS // 8)
+    _count_staged(len(xs) * nbytes * 3)  # u16 staging + the u32 copy
     buf = bytearray(len(xs) * nbytes)
     for row, x in enumerate(xs):
         if x < 0:

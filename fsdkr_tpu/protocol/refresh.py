@@ -510,7 +510,7 @@ class RefreshMessage:
             for msg in refresh_messages
             for i in range(n)
         ]
-        if not all(backend.validate_feldman(items)):
+        if not all(_feldman_streamed(backend, items)):
             raise PublicShareValidationError()
 
     # ------------------------------------------------------------------
@@ -732,7 +732,16 @@ class RefreshMessage:
             )
             feld_spans[s] = (lo, len(feld_items))
         if feld_items:
-            feld_verdicts = fused(backend.validate_feldman, feld_items, feld_spans)
+            # the EC columns stream through the same bytes-budgeted tile
+            # plan as the pair rows (backend.memplan): Feldman verdicts
+            # are row-local (the per-scheme RLC combine falls back to
+            # exact per-row checks on failure), so cutting the row axis
+            # cannot change any verdict
+            feld_verdicts = fused(
+                lambda items: _feldman_streamed(backend, items),
+                feld_items,
+                feld_spans,
+            )
             for s, (lo, hi) in feld_spans.items():
                 if errors[s] is None and not all(feld_verdicts[lo:hi]):
                     errors[s] = PublicShareValidationError()
@@ -875,6 +884,20 @@ class RefreshMessage:
                 except Exception as e:
                     errors[s] = e
         return errors
+
+
+def _feldman_streamed(backend, items):
+    """validate_feldman under the bytes-budgeted memory plan
+    (backend.memplan.streamed_rows): tiles of the EC row axis verify one
+    at a time, so the Feldman columns never hold the whole n^2 point set
+    staged at once — the same discipline the pair rows get from
+    `_verify_pairs_streamed`. Single-tile plans (and FSDKR_MEM_PLAN=0)
+    call through unchanged."""
+    from ..backend import memplan
+
+    return memplan.streamed_rows(
+        backend.validate_feldman, items, memplan.ec_row_bytes(), "feldman"
+    )
 
 
 def fused_isolated(call, lists, spans, errors):

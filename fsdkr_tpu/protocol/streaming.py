@@ -34,11 +34,26 @@ receiver's secrets (paillier_dk, the new dk) are only touched inside
 the shared `adopt_session` at finalize, same as the barrier path; no
 cross-session material enters any per-session buffer (SECURITY.md
 "Serving discipline").
+
+## Memory (ISSUE 10)
+
+A session's staged pair rows are REFERENCES into the broadcast
+messages — O(n) per arrived message, tracked by the
+`fsdkr_mem_stream_rows` gauge — and the wide staged operand data only
+materializes at finalize, which runs `backend.verify_pairs` and
+therefore inherits the bytes-budgeted tile plan (backend.memplan,
+FSDKR_MEM_BUDGET_MB): build -> stage -> verify -> wipe per tile, RLC
+folds as running per-group partial products. A serving worker's
+per-session resident memory is thus bounded by O(n) references plus
+O(tile) staged bytes regardless of committee size or how many sessions
+a coalesced `finalize_streams` launch fuses
+(tests/test_memplan.py::test_streaming_collect_on_tiles_parity).
 """
 
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..backend import get_backend
@@ -202,6 +217,7 @@ class StreamingCollect:
                     )
                 )
             self._pairs[pid] = (pdl_rows, range_rows)
+            _track_session(self)
 
     # -- introspection --------------------------------------------------
     @property
@@ -466,4 +482,35 @@ def _finalize_impl(streams, errors, config):
             continue
         st._done = True
         st._result = errors[s]
+        # staged pair-row references retire with the session (the wide
+        # staged operands already died tile-by-tile inside verify_pairs)
+        st._pairs.clear()
     return errors
+
+
+# Live staged pair-row accounting across open streaming sessions — the
+# serving loop's bounded-per-session-memory reading (module docstring
+# "Memory"). A WeakSet + function gauge, not inc/dec counters: serving
+# abort paths can drop a StreamingCollect without ever reaching
+# finalize, and a decrement-based gauge would leak upward forever in
+# exactly the degraded scenarios it exists to monitor. Garbage-collected
+# sessions simply fall out of the sum.
+_OPEN_SESSIONS: "weakref.WeakSet[StreamingCollect]" = weakref.WeakSet()
+
+
+def _stream_rows_total() -> float:
+    total = 0
+    for st in list(_OPEN_SESSIONS):
+        total += len(st._pairs) * st.new_n
+    return float(total)
+
+
+def _track_session(st: "StreamingCollect") -> None:
+    from ..telemetry import registry
+
+    _OPEN_SESSIONS.add(st)
+    registry.gauge(
+        "fsdkr_mem_stream_rows",
+        "pair rows currently staged across open streaming-collect "
+        "sessions (references into broadcast messages)",
+    ).set_function(_stream_rows_total)

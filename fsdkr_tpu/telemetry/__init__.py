@@ -68,6 +68,11 @@ __all__ = [
 # crash-path handlers: only when FSDKR_FLIGHT names a destination
 flight.install()
 
+# the peak-RSS function gauge (ISSUE 10): always registered, evaluated
+# only at snapshot time — every bench JSON / loadgen report / Prometheus
+# dump carries the process VmHWM high-water mark
+registry.install_rss_gauge()
+
 
 def _atexit_exports() -> None:
     """Best-effort export at interpreter exit so a run that simply ends

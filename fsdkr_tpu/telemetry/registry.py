@@ -52,6 +52,8 @@ __all__ = [
     "counter",
     "gauge",
     "histogram",
+    "vmhwm_bytes",
+    "install_rss_gauge",
 ]
 
 # bumped on any breaking change to the snapshot layout; consumers
@@ -466,6 +468,35 @@ class Registry:
 
 
 _REGISTRY = Registry()
+
+
+def vmhwm_bytes() -> int:
+    """Process peak RSS in bytes — VmHWM from /proc/self/status, the
+    kernel's high-water mark of resident set size. This is the ground
+    truth the memory plan's staged-bytes estimates are sanity-checked
+    against (ISSUE 10); 0 when the proc file is unavailable
+    (non-Linux). Reading costs one small proc-file scan, so it is safe
+    as a function gauge evaluated only at snapshot time."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return 0
+
+
+def install_rss_gauge() -> Gauge:
+    """Register the peak-RSS function gauge (idempotent). Installed at
+    telemetry package import so every snapshot — bench JSONs, loadgen
+    reports, Prometheus dumps — carries the process high-water mark."""
+    g = _REGISTRY.gauge(
+        "fsdkr_mem_rss_peak_bytes",
+        "process peak RSS (VmHWM from /proc/self/status)",
+    )
+    g.set_function(lambda: float(vmhwm_bytes()))
+    return g
 
 
 def get_registry() -> Registry:

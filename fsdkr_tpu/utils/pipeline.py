@@ -25,6 +25,7 @@ from typing import Callable, List, Optional, Sequence
 __all__ = [
     "pipeline_enabled",
     "pipelined",
+    "prefetch_tiles",
     "submit_bg",
     "run_jobs",
     "BackgroundProducer",
@@ -76,6 +77,48 @@ def pipelined(run: Callable, args_list: Sequence[tuple], depth: int = _DEPTH) ->
                 futs[nxt] = ex.submit(worker, *args_list[nxt])
                 nxt += 1
     return out
+
+
+def prefetch_tiles(spans, prepare: Callable, consume: Callable) -> None:
+    """Double-buffered streaming for the memory-planned verification
+    tiles (backend.memplan): while tile k's `consume` runs its engine
+    launches (GIL-released native/GMP calls, async device dispatch),
+    tile k+1's `prepare` — host-only staging: domain gates, Fiat-Shamir
+    hashing, fold-row construction — runs on one background thread. At
+    most TWO tiles' prepared state is live at any instant, which is
+    exactly the `inflight` factor the tile planner budgets for.
+
+    `consume` is always called on the submitting thread, in span order,
+    so accumulator mutation needs no locks and the result is
+    bit-identical to the sequential loop (same determinism contract as
+    `pipelined`). Sequential when pipelining is disabled. `prepare` must
+    be read-only over shared state. Exceptions propagate from whichever
+    callable raised them first in span order."""
+    spans = list(spans)
+    if not spans:
+        return
+    if len(spans) == 1 or not pipeline_enabled():
+        for s in spans:
+            consume(prepare(*s))
+        return
+    from concurrent.futures import ThreadPoolExecutor
+
+    from .trace import get_tracer
+
+    tracer = get_tracer()
+    parent = tracer.current_span() or tracer.current_phase()
+
+    def worker(*args):
+        with tracer.inherit_phase(parent):
+            return prepare(*args)
+
+    with ThreadPoolExecutor(max_workers=1) as ex:
+        fut = ex.submit(worker, *spans[0])
+        for i in range(len(spans)):
+            prep = fut.result()
+            if i + 1 < len(spans):
+                fut = ex.submit(worker, *spans[i + 1])
+            consume(prep)
 
 
 def _sched_workers() -> int:

@@ -104,6 +104,37 @@ assert flight["events"], "flight ring empty"
 print("telemetry export leg ok:", len(spans), "spans")
 EOF
 
+echo "== test: memory-plan leg (tiny budget, multi-tile path) =="
+# the smoke tier above ran with the default FSDKR_MEM_BUDGET_MB=256,
+# where every test-size batch fits one tile and verify_pairs takes the
+# monolithic path; this leg forces a deliberately tiny budget so a real
+# refresh runs the multi-tile streaming path (running per-group RLC
+# partial folds, per-tile range/EC verification, stage/release
+# accounting) on every commit — the path the n=256 full-width run
+# depends on cannot rot between batteries
+FSDKR_MEM_BUDGET_MB=0.02 python -m pytest tests/test_memplan.py -q \
+  -m "not slow and not heavy" -p no:cacheprovider
+FSDKR_MEM_BUDGET_MB=0.01 python - <<'EOF'
+from fsdkr_tpu.config import TEST_CONFIG
+from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+from fsdkr_tpu.backend import memplan, rlc
+
+keys = simulate_keygen(1, 3, TEST_CONFIG)
+cfg = TEST_CONFIG.with_backend("tpu")
+out = RefreshMessage.distribute_batch([(k.i, k) for k in keys], 3, cfg)
+rlc.stats_reset()
+RefreshMessage.collect([m for m, _ in out], keys[0].clone(),
+                       out[0][1], (), cfg)
+mem = memplan.mem_stats()
+assert mem["tiles"] > 1, f"tiny budget did not tile: {mem}"
+assert rlc.stats()["stream_tiles"] > 1, rlc.stats()
+assert rlc.stats()["bisect_fallbacks"] == 0, rlc.stats()
+assert mem["peak_resident_bytes"] > 0
+print("memory-plan leg ok:", mem["tiles"], "tiles, peak",
+      mem["peak_resident_bytes"], "bytes under budget",
+      mem["budget_bytes"])
+EOF
+
 echo "== test: FSDKR_PRECOMPUTE=0 leg (inline prover path) =="
 # the smoke tier above ran with the default FSDKR_PRECOMPUTE=1 (pool
 # consume-or-compute in distribute); this leg forces the inline path on

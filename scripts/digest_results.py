@@ -12,10 +12,29 @@ Usage: python scripts/digest_results.py [bench_results_dir]
 
 import json
 import pathlib
+import re
 import sys
+
+# very-large-report guards (ISSUE 10: n=256 full-width bench JSONs carry
+# multi-megabyte telemetry/trace blocks): refuse to slurp a file past
+# the hard cap, and never re-attempt json.loads per line on huge broken
+# lines (the old fallback re-parsed a failed multi-MB line once per
+# line, quadratic on corrupt big reports)
+_MAX_FILE_BYTES = 512 * (1 << 20)
+_MAX_LINE_BYTES = 64 * (1 << 20)
 
 
 def load(path):
+    try:
+        if path.stat().st_size > _MAX_FILE_BYTES:
+            print(
+                f"digest: skipping {path} "
+                f"({path.stat().st_size >> 20} MB > cap)",
+                file=sys.stderr,
+            )
+            return []
+    except OSError:
+        return []
     text = path.read_text()
     # whole-file object first (pretty-printed reports); JSON-lines after
     try:
@@ -26,7 +45,7 @@ def load(path):
     recs = []
     for line in text.splitlines():
         line = line.strip()
-        if not line:
+        if not line or len(line) > _MAX_LINE_BYTES:
             continue
         try:
             rec = json.loads(line)
@@ -37,14 +56,34 @@ def load(path):
     return recs
 
 
+def is_structural_proxy(rec) -> bool:
+    """True when a collect-config record was measured at reduced
+    parameters (the cpu_scale_n256* 768-bit/M=32 runs) or self-declares
+    as structural — such rows must never read as full-parameter
+    (2048-bit/M=256) numbers. A dry-run memory-plan report is also a
+    proxy: it planned, it did not verify."""
+    metric = str(rec.get("metric", ""))
+    if "[structural" in metric or "dry-run" in metric or rec.get("dry_run"):
+        return True
+    m = re.search(r"(\d+)-bit", metric)
+    return bool(m) and int(m.group(1)) < 2048
+
+
 def main():
     root = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else "bench_results")
     configs, kernels, traces, ec_ab = [], [], {}, []
     mfu, other_kernel_recs = [], 0
     serving = []
     # serving reports live both as battery steps (m_serve_*.json) and as
-    # the loadgen's own serving_*.json artifacts
-    paths = sorted(root.glob("m_*.json")) + sorted(root.glob("serving_*.json"))
+    # the loadgen's own serving_*.json artifacts; the cpu_scale_* /
+    # cpu_full_* structural and full-width runs digest too (ISSUE 10),
+    # with reduced-parameter rows labeled as proxies below
+    paths = (
+        sorted(root.glob("m_*.json"))
+        + sorted(root.glob("serving_*.json"))
+        + sorted(root.glob("cpu_scale_*.json"))
+        + sorted(root.glob("cpu_full_*.json"))
+    )
     for path in paths:
         name = path.stem[2:] if path.stem.startswith("m_") else path.stem
         for rec in load(path):
@@ -88,12 +127,17 @@ def main():
         print("### collect() configurations\n")
         print("| step | metric | platform | proofs/s | warm s | cold s | vs native C++ | vs CPython |")
         print("|---|---|---|---|---|---|---|---|")
+        any_proxy = False
         for name, r in configs:
             plat = r.get("platform") or "—"
             if r.get("fallback_note"):
                 plat += " (FALLBACK)"
+            step = name
+            if is_structural_proxy(r):
+                step = f"proxy: {name}"
+                any_proxy = True
             print(
-                f"| {name} | {r['metric']} | {plat} | {r.get('value', 0)} "
+                f"| {step} | {r['metric']} | {plat} | {r.get('value', 0)} "
                 f"| {r.get('collect_warm_s', '—')} | {r.get('collect_cold_s', '—')} "
                 f"| {r.get('vs_baseline', '—')}x | {r.get('vs_cpython', '—')}x |"
             )
@@ -101,18 +145,31 @@ def main():
                 print(f"|  | ERROR: {r['error'][:90]} | | | | | | |")
             if r.get("fallback_note"):
                 print(f"|  | note: {r['fallback_note'][:110]} | | | | | | |")
+        if any_proxy:
+            print(
+                "\n`proxy:` rows are reduced-parameter structural runs "
+                "(e.g. 768-bit/M=32 cpu_scale_n256*) or plan-only dry "
+                "runs — NOT full-parameter (2048-bit/M=256) numbers."
+            )
         print()
 
     for name, (tr, mfu) in traces.items():
         print(f"### per-phase breakdown: {name}\n")
         print("| phase | seconds | GMACs | mfu |")
         print("|---|---|---|---|")
-        for phase, secs in sorted(tr.items(), key=lambda kv: -kv[1]):
+        # cap the table for very large reports (an n=256 full-width
+        # trace carries every tile's sub-phases): top 25 by time, with
+        # the tail summarized instead of silently dropped
+        rows_t = sorted(tr.items(), key=lambda kv: -kv[1])
+        for phase, secs in rows_t[:25]:
             m = mfu.get(phase, {})
             print(
                 f"| {phase} | {secs} | {m.get('gmacs', '—')} "
                 f"| {m.get('mfu', '—')} |"
             )
+        if len(rows_t) > 25:
+            rest = round(sum(s for _, s in rows_t[25:]), 3)
+            print(f"| ({len(rows_t) - 25} more phases) | {rest} | — | — |")
         print()
         # verify_pairs sub-phase view (ISSUE 8): the pair-loop wall and
         # its removal must be visible WITHOUT opening the Chrome trace —
@@ -174,10 +231,27 @@ def main():
                     f"| {v['p99']} |"
                 )
             print()
+        mem = rec.get("mem")
+        if mem:
+            print("| memory plan | value |")
+            print("|---|---|")
+            for k in (
+                "budget_bytes", "peak_resident_bytes", "rss_peak_bytes",
+                "bytes_staged", "tiles", "plan_enabled",
+            ):
+                if k in mem:
+                    v = mem[k]
+                    if isinstance(v, int) and v >= 1 << 20 and k != "tiles":
+                        v = f"{v} ({v >> 20} MB)"
+                    print(f"| {k} | {v} |")
+            print()
         gauge_rows = []
         for gname in (
             "fsdkr_pool_depth", "fsdkr_pool_bytes", "fsdkr_pool_count",
             "fsdkr_producer_occupancy", "fsdkr_producer_steps",
+            "fsdkr_mem_budget_bytes", "fsdkr_mem_peak_resident_bytes",
+            "fsdkr_mem_rss_peak_bytes", "fsdkr_mem_tile_rows",
+            "fsdkr_mem_plan_rows",
         ):
             for v in metrics.get(gname, {}).get("values", []):
                 labels = ",".join(

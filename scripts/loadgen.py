@@ -72,6 +72,14 @@ def parse_args():
     return p.parse_args()
 
 
+def _mem_block():
+    """Memory-plan stat block for the serving report (matches bench.py's
+    `mem` field): budget, staged/peak bytes, process VmHWM, tiles."""
+    from fsdkr_tpu.backend import memplan
+
+    return memplan.mem_stats()
+
+
 def percentile(sorted_vals, q):
     if not sorted_vals:
         return None
@@ -252,6 +260,10 @@ def main():
             "prefill_deficit_left": deficit_left,
         },
         "producer": prod,
+        # per-process memory accounting (ISSUE 10): VmHWM ground truth +
+        # the memory-plan block — the serving loop's bounded-per-session
+        # claim is checkable from the report alone
+        "mem": _mem_block(),
         "setup": {
             "keygen_s": round(keygen_s, 1),
             "seed_epochs": args.seed_epochs,

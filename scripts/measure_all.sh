@@ -189,6 +189,17 @@ run sharedexp_kernel 120 python scripts/bench_kernels.py sharedexp
 # mirroring the n16_nocrt pattern). The CPU-platform acceptance pair is
 # bench_results/precompute_ab_n16_{on,off}.json.
 run n16_noprecompute 2400 FSDKR_PRECOMPUTE=0 FSDKR_TRACE=1 python bench.py
+# memory-plan A/B (ISSUE 10, FSDKR_MEM_PLAN: =0 restores the monolithic
+# all-rows-resident gather/stage/verify path; =1 is the default — the
+# nominal n16 step above measures it and emits the "mem" stat block
+# {budget_bytes, bytes_staged, peak_resident_bytes, rss_peak_bytes,
+# tiles}; this step is the off arm at the same shape, mirroring the
+# n16_norlc pattern). At n=16 the default budget fits one tile, so the
+# two arms must match within noise; the multi-tile path is measured by
+# the n256_full / n64_fullwidth steps below. The CPU-platform acceptance
+# pair is bench_results/memplan_ab_n16_{on,off}.json.
+run n16_nomemplan 2400 FSDKR_MEM_PLAN=0 FSDKR_TRACE=1 python bench.py
+
 # telemetry trace-overhead A/B (ISSUE 6): one traced bench run that adds
 # an extra warm collect with the tracer forced OFF in the same process —
 # the JSON carries collect_warm_s (traced), collect_warm_notrace_s
@@ -237,6 +248,36 @@ run_local "n16_host_tauto" 3600 BENCH_PLATFORM=cpu FSDKR_THREADS=auto \
 run_local serve_sustained 3000 JAX_PLATFORMS=cpu \
   python scripts/loadgen.py --committees 200 --bases 4 --window 60 \
   --prefill-wait 90 --tag sustained
+
+# north-star shape at FULL parameters (ISSUE 10 / ROADMAP item 3): the
+# n=256 / 2048-bit / M=256 end-to-end run under the memory plan. Pinned
+# to the host platform (run_local) so a tunnel outage cannot eat the
+# multi-hour step; FSDKR_MEM_BUDGET_MB=256 forces the multi-tile
+# streaming path at this shape (the pair plan estimates ~1.6 GB
+# all-resident), and BENCH_HOST_PAIRS caps the serial host-baseline
+# subsample so the step's wall-clock is the measured run, not the
+# oracle. DOCUMENTED FALLBACK: if the step times out or fails on this
+# host (single-core n=256 full width is hours), the battery degrades to
+# (a) the n=64 full-width end-to-end run under a deliberately tight
+# budget — the tiled path at full width, just a smaller committee — and
+# (b) the n=256 memory-plan dry-run report (scripts/memplan_report.py,
+# plan-only, labeled a proxy by digest_results.py). Together they pin
+# what the full run would: the plan bounds the shape, the tiles verify
+# at full width.
+run_local n256_full 28800 BENCH_PLATFORM=cpu BENCH_N=256 BENCH_T=128 \
+  BENCH_HOST_PAIRS=64 FSDKR_MEM_BUDGET_MB=256 FSDKR_TRACE=1 python bench.py
+if [ -e "$R/m_n256_full.ok" ] && [ -s "$R/m_n256_full.json" ]; then
+  cp "$R/m_n256_full.json" "$R/cpu_full_n256.json"
+  echo "n256_full -> cpu_full_n256.json"
+else
+  echo "n256_full unavailable: degrading to the documented n=64 fallback"
+  run_local n64_fullwidth 7200 BENCH_PLATFORM=cpu BENCH_N=64 BENCH_T=32 \
+    BENCH_HOST_PAIRS=64 FSDKR_MEM_BUDGET_MB=16 FSDKR_TRACE=1 python bench.py
+  [ -e "$R/m_n64_fullwidth.ok" ] && \
+    cp "$R/m_n64_fullwidth.json" "$R/cpu_full_n64_fullwidth.json"
+  python scripts/memplan_report.py --out "$R/cpu_full_n256.json" \
+    > "$R/cpu_full_n256.log" 2>&1 || true
+fi
 
 # canonical BENCH datapoint from the battery, copied to the repo root so
 # the round's bench trajectory is populated even if the driver never

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Memory-plan dry run for a collect() shape (ISSUE 10): compute the
+bytes-budgeted streaming verification plan — tile sizes, tile counts,
+planned in-flight staged bytes — for a given (n, paillier_bits) shape
+WITHOUT running the protocol. The plan is a pure function of public row
+counts and width buckets (backend.memplan), so no keys are generated and
+the report costs milliseconds.
+
+This is the documented fallback artifact for the north-star n=256
+full-parameter run: when the host cannot finish the end-to-end run
+inside a battery window (measure_all.sh `n256_full`), the dry-run report
+plus the n=64 full-width end-to-end run (`cpu_full_n64_fullwidth.json`)
+together pin (a) that the planner bounds the n=256 shape under the
+budget and (b) that the tiled path actually verifies at full width.
+The record is marked `"dry_run": true` and its metric says so —
+digest_results.py labels it a proxy, never a full-parameter number.
+
+Usage:
+  python scripts/memplan_report.py [--n 256] [--t 128] [--bits 2048]
+      [--m 256] [--out bench_results/cpu_full_n256.json]
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--n", type=int, default=256)
+    p.add_argument("--t", type=int, default=128)
+    p.add_argument("--bits", type=int, default=2048)
+    p.add_argument("--m", type=int, default=256)
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+
+    from fsdkr_tpu.backend import memplan
+
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "unknown"
+
+    n, bits = args.n, args.bits
+    pair_rows = n * n  # one PDL + one range row per (sender, receiver)
+    feld_rows = n * n
+    nn_bits = 2 * bits  # mod n^2 width
+    nt_bits = bits
+    row_b = memplan.pair_row_bytes(nn_bits, nt_bits)
+    plan = memplan.plan_rows(pair_rows, row_b, label="pairs")
+    feld_plan = memplan.plan_rows(
+        feld_rows, memplan.ec_row_bytes(), label="feldman"
+    )
+
+    def plan_block(pl):
+        if pl is None:
+            return {"enabled": False}
+        return {
+            "rows": pl.rows,
+            "row_bytes": pl.row_bytes,
+            "tile_rows": pl.tile_rows,
+            "tiles": len(pl.tiles),
+            "inflight": pl.inflight,
+            # in-flight staged bytes: inflight tiles, capped by the
+            # whole row set (a single-tile plan peaks at rows, not 2x)
+            "planned_peak_bytes": pl.tile_bytes(
+                min(pl.rows, pl.tile_rows * pl.inflight)
+            ),
+            "budget_bytes": pl.budget,
+            "monolithic_estimate_bytes": pl.rows * pl.row_bytes,
+        }
+
+    pairs = plan_block(plan)
+    rec = {
+        "metric": (
+            f"memory-plan dry run @ n={n},t={args.t},{bits}-bit,"
+            f"M={args.m} [plan only — see cpu_full_n64_fullwidth.json "
+            f"for the end-to-end full-width run]"
+        ),
+        "dry_run": True,
+        "value": 0,
+        "unit": "proofs/s",
+        "vs_baseline": 0,
+        "platform": platform,
+        "n": n,
+        "t": args.t,
+        "paillier_bits": bits,
+        "m_security": args.m,
+        "budget_mb": os.environ.get("FSDKR_MEM_BUDGET_MB", "256"),
+        "pair_plan": pairs,
+        "feldman_plan": plan_block(feld_plan),
+        "mem": memplan.mem_stats(),
+    }
+    if pairs.get("tiles"):
+        # the headline claim: bounded in-flight staged bytes vs the
+        # monolithic all-rows-resident estimate
+        rec["resident_reduction_x"] = round(
+            pairs["monolithic_estimate_bytes"]
+            / max(1, pairs["planned_peak_bytes"]),
+            2,
+        )
+    out = args.out or "bench_results/cpu_full_n256.json"
+    pathlib.Path(out).parent.mkdir(parents=True, exist_ok=True)
+    pathlib.Path(out).write_text(json.dumps(rec, indent=1) + "\n")
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
