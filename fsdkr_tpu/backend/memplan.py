@@ -81,12 +81,29 @@ def mem_budget_bytes() -> int:
     default 256). The planner sizes tiles so the tiles concurrently in
     flight (two under the double-buffered pipeline) fit the budget; a
     budget below one row's estimate degrades to 1-row tiles — the plan
-    never refuses to run."""
+    never refuses to run. Under an active fault plan (FSDKR_FAULTS,
+    ISSUE 11) a mem_squeeze injection shrinks one planning decision's
+    budget by the plan's squeeze factor — verdicts and blame are
+    budget-independent by the memplan contract, so a squeeze costs
+    tiles, never correctness."""
     try:
         mb = float(os.environ.get("FSDKR_MEM_BUDGET_MB", "256"))
     except ValueError:
         mb = 256.0
-    return max(1, int(mb * (1 << 20)))
+    return _fault_squeeze(max(1, int(mb * (1 << 20))))
+
+
+def _fault_squeeze(budget: int) -> int:
+    """Consult the serving fault plan via sys.modules only (never an
+    import): zero cost unless a chaos run already loaded
+    fsdkr_tpu.serving.faults AND configured a plan."""
+    import sys
+
+    m = sys.modules.get("fsdkr_tpu.serving.faults")
+    if m is None:
+        return budget
+    plan = m.active()
+    return budget if plan is None else plan.squeeze_budget(budget)
 
 
 def pair_row_bytes(nn_bits: int, nt_bits: int) -> int:

@@ -154,6 +154,37 @@ def _events():
     )
 
 
+def _dry_events():
+    """Cause-labeled dry-fallback counter (ISSUE 11): an injected
+    pool-dry storm (FSDKR_FAULTS) must be distinguishable from a real
+    producer regression, or chaos runs would hide exactly the
+    regressions the dry-rate gate exists to catch. The legacy
+    `fsdkr_pool_events{event=dry_fallbacks}` counter keeps counting
+    BOTH causes (precompute_stats totals are unchanged); this counter
+    splits them."""
+    from ..telemetry import registry
+
+    return registry.counter(
+        "fsdkr_pool_dry",
+        "pool dry fallbacks by kind and cause (real | injected)",
+        labelnames=("kind", "cause"),
+    )
+
+
+def _injected_dry() -> bool:
+    """Consult the serving fault plan WITHOUT importing it: a process
+    that never ran chaos pays one sys.modules dict hit here and never
+    imports the serving package (the zero-cost-when-disabled rule,
+    SECURITY.md "Fault-injection discipline")."""
+    import sys
+
+    m = sys.modules.get("fsdkr_tpu.serving.faults")
+    if m is None:
+        return False
+    plan = m.active()
+    return plan is not None and plan.fire_seq("pool_dry")
+
+
 def _bytes_gauge():
     from ..telemetry import registry
 
@@ -181,11 +212,19 @@ class PrecomputeStore:
     def take(self, kind: str, key) -> Optional[tuple]:
         """Pop and consume the oldest entry of pool (kind, key); None
         (counted as a dry fallback) when the pool is dry — the caller
-        then computes inline, bit-identically."""
+        then computes inline, bit-identically. An injected pool-dry
+        storm (FSDKR_FAULTS) forces the same dry fallback on a full
+        pool — the entry stays pooled, only this take is starved — and
+        is labeled cause=injected."""
+        if _injected_dry():
+            _events().inc(event="dry_fallbacks", kind=kind)
+            _dry_events().inc(kind=kind, cause="injected")
+            return None
         with self._lock:
             pool = self._pools.get((kind, key))
             if not pool:
                 _events().inc(event="dry_fallbacks", kind=kind)
+                _dry_events().inc(kind=kind, cause="real")
                 return None
             ent = pool.popleft()
             if not pool:

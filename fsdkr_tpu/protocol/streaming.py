@@ -246,6 +246,24 @@ class StreamingCollect:
         list barrier `collect` would be called with."""
         return [self._msgs[pid] for pid in self.expected]
 
+    def close(self, error: Optional[Exception] = None) -> bool:
+        """Terminate this session WITHOUT adoption — the deadline
+        reaper's entry point (ISSUE 11) and a teardown hygiene hook.
+        Marks the session done with `error` as its stored verdict and
+        releases the staged pair-row references now; afterwards `offer`
+        returns "late" and any finalize (including a fused launch
+        already holding this session) replays the stored verdict
+        instead of verifying or mutating the LocalKey. Returns False
+        (no-op) when the session already finished — a completed verdict
+        is never overwritten."""
+        with self._lock:
+            if self._done:
+                return False
+            self._done = True
+            self._result = error
+            self._pairs.clear()
+            return True
+
     # -- completion -----------------------------------------------------
     def finalize(self) -> None:
         """Finish this session alone: quorum-time pair fold + the

@@ -15,17 +15,28 @@ API (`distribute_batch` + `collect_sessions`).
 """
 
 from .planner import SLO, CapacityPlanner, serve_owner  # noqa: F401
-from .policy import BatchPolicy  # noqa: F401
-from .service import RefreshService, ServeSession, enabled  # noqa: F401
-from . import metrics  # noqa: F401
+from .policy import BatchPolicy, BisectGuard, OverloadPolicy  # noqa: F401
+from .service import (  # noqa: F401
+    RefreshService,
+    ServeRejected,
+    ServeSession,
+    SessionTimeout,
+    enabled,
+)
+from . import faults, metrics  # noqa: F401
 
 __all__ = [
     "SLO",
     "CapacityPlanner",
     "serve_owner",
     "BatchPolicy",
+    "OverloadPolicy",
+    "BisectGuard",
     "RefreshService",
     "ServeSession",
+    "ServeRejected",
+    "SessionTimeout",
     "enabled",
+    "faults",
     "metrics",
 ]

@@ -23,6 +23,8 @@ __all__ = [
     "committees_gauge",
     "record_phase",
     "record_outcome",
+    "rlc_bisect_count",
+    "retries_counter",
 ]
 
 # end-to-end latencies span ~10 ms smoke sessions to minutes under
@@ -37,8 +39,17 @@ _SECONDS_BUCKETS = (
 def sessions_counter():
     return registry.counter(
         "fsdkr_serving_sessions",
-        "refresh sessions finished, by outcome (done/aborted)",
+        "refresh sessions finished, by outcome "
+        "(done/aborted/timed_out/rejected)",
         labelnames=("outcome",),
+    )
+
+
+def retries_counter():
+    return registry.counter(
+        "fsdkr_serving_retries",
+        "transient-failure retries, by stage (worker/finalize)",
+        labelnames=("stage",),
     )
 
 
@@ -87,4 +98,22 @@ def record_phase(phase: str, seconds: float) -> None:
 
 def record_outcome(outcome: str, total_seconds: float) -> None:
     sessions_counter().inc(outcome=outcome)
-    phase_histogram().observe(total_seconds, phase="total")
+    # a rejected submission never became a session: no latency sample
+    if outcome != "rejected":
+        phase_histogram().observe(total_seconds, phase="total")
+
+
+def rlc_bisect_count() -> int:
+    """Lifetime RLC bisection fallbacks, read through the registry.
+    The serving layer may not import `backend.rlc` (layering rule), but
+    the counter is shared process state: look it up by name, 0 when the
+    verifier has not created it yet (no re-declaration here — a name or
+    label drift in backend.rlc must not silently fork a parallel
+    always-zero counter)."""
+    m = registry.get_registry().get("fsdkr_rlc_events")
+    if m is None:
+        return 0
+    try:
+        return int(m.value(event="bisect_fallbacks"))
+    except Exception:
+        return 0

@@ -1,26 +1,48 @@
-"""Batching policy for the coalescing finalize launcher (ISSUE 9).
+"""Serving policies: finalize batching, admission overload shedding,
+and the bisection-storm guard (ISSUE 9 batching; ISSUE 11 degradation).
 
-Quorum-ready streaming sessions are fused into one `finalize_streams`
-launch; the policy decides WHEN to launch and HOW MANY sessions to take.
-Classic size-or-linger batching: launch immediately once
-`FSDKR_SERVE_BATCH` sessions are ready, otherwise wait up to
-`FSDKR_SERVE_LINGER_MS` from the oldest ready session before launching
-whatever is there — throughput from fusion without unbounded latency
-(the SZKP-style producer/consumer decoupling needs the consumer launch
-to stay full, but a p99 budget caps how long a session may sit waiting
-for company).
+`BatchPolicy` — quorum-ready streaming sessions are fused into one
+`finalize_streams` launch; the policy decides WHEN to launch and HOW
+MANY sessions to take. Classic size-or-linger batching: launch
+immediately once `FSDKR_SERVE_BATCH` sessions are ready, otherwise wait
+up to `FSDKR_SERVE_LINGER_MS` from the oldest ready session before
+launching whatever is there — throughput from fusion without unbounded
+latency (the SZKP-style producer/consumer decoupling needs the consumer
+launch to stay full, but a p99 budget caps how long a session may sit
+waiting for company).
 
 Mesh awareness: on a real device mesh the fused pair launch row-shards
 over all devices, so the policy prefers batch sizes whose total row
 count divides the mesh (`parallel.shard_kernels.align_session_batch`);
 on the host path (device count 1) alignment is a no-op.
+
+`OverloadPolicy` — graceful degradation at admission (2G2T's
+loaded-shard regime: keep the latency SLO by shedding, not by queueing
+divergence). `submit()` is rejected with a retry-after hint when the
+admission queue is past `FSDKR_SERVE_MAX_QUEUE` or the measured
+end-to-end p99 exceeds `FSDKR_SERVE_SHED_P99` x the committee's SLO
+budget. Both default OFF (0): an unconfigured service behaves exactly
+as before.
+
+`BisectGuard` — per-committee budget on RLC bisection work per sliding
+window (ROADMAP 5b economics). Honest transcripts bisect ZERO times, so
+bisections are an attributable cost of tampered traffic; a committee
+whose sessions forced more than `FSDKR_SERVE_BISECT_BUDGET` bisection
+fallbacks inside `FSDKR_SERVE_BISECT_WINDOW_S` seconds is shed at
+admission until the window rolls — 5% malicious traffic pays with its
+own committee's throughput instead of DoSing the shard's verify
+engines. Default OFF (budget 0).
 """
 
 from __future__ import annotations
 
 import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
 
-__all__ = ["BatchPolicy"]
+__all__ = ["BatchPolicy", "OverloadPolicy", "BisectGuard"]
 
 
 def _env_int(name: str, default: int) -> int:
@@ -76,3 +98,120 @@ class BatchPolicy:
         """Seconds the launcher may sleep before the linger deadline of
         the oldest ready session expires."""
         return max(0.0, self.linger_s - oldest_wait_s)
+
+
+class OverloadPolicy:
+    """Admission-time shedding. `check()` returns None (admit) or a
+    retry-after hint in seconds (reject). Reads its thresholds from the
+    environment at construction; both gates default off."""
+
+    def __init__(
+        self,
+        max_queue: Optional[int] = None,
+        shed_p99_factor: Optional[float] = None,
+    ):
+        self.max_queue = (
+            max_queue
+            if max_queue is not None
+            else _env_int("FSDKR_SERVE_MAX_QUEUE", 0)
+        )
+        self.shed_p99_factor = (
+            shed_p99_factor
+            if shed_p99_factor is not None
+            else _env_float("FSDKR_SERVE_SHED_P99", 0.0)
+        )
+
+    def engaged(self) -> bool:
+        """False when both gates are off (the default) — the caller can
+        then skip computing the measured p99 entirely, keeping the
+        submit hot path free of histogram scans under the service
+        lock."""
+        return self.max_queue > 0 or self.shed_p99_factor > 0
+
+    def check(
+        self,
+        queue_depth: int,
+        measured_p99_s: float,
+        p99_budget_s: float,
+    ) -> Optional[float]:
+        """None = admit. A float = reject, retry after that many
+        seconds. The hint is honest but cheap: the measured p99 itself
+        (the time by which the backlog that caused the shed has very
+        likely cleared), floored at 100 ms."""
+        if self.max_queue > 0 and queue_depth >= self.max_queue:
+            return max(0.1, measured_p99_s)
+        if (
+            self.shed_p99_factor > 0
+            and p99_budget_s > 0
+            and measured_p99_s > self.shed_p99_factor * p99_budget_s
+        ):
+            return max(0.1, measured_p99_s)
+        return None
+
+
+class BisectGuard:
+    """Sliding-window per-committee budget on RLC bisection fallbacks.
+    `charge(committee, n)` records bisection work attributed to the
+    committee; `blocked(committee)` returns the seconds until its
+    window has room again, or None while it is under budget. Committees
+    never forced a bisection (every honest committee) are never
+    touched. Budget 0 disables the guard entirely."""
+
+    def __init__(
+        self,
+        budget: Optional[int] = None,
+        window_s: Optional[float] = None,
+    ):
+        self.budget = (
+            budget
+            if budget is not None
+            else _env_int("FSDKR_SERVE_BISECT_BUDGET", 0)
+        )
+        self.window_s = (
+            window_s
+            if window_s is not None
+            else _env_float("FSDKR_SERVE_BISECT_WINDOW_S", 60.0)
+        )
+        self._events: Dict[object, deque] = {}
+        # charged by the launcher thread, read by submit() under the
+        # service lock — the guard carries its own lock
+        self._lock = threading.Lock()
+
+    def enabled(self) -> bool:
+        return self.budget > 0
+
+    def _prune(self, q: deque, now: float) -> None:
+        while q and now - q[0][0] > self.window_s:
+            q.popleft()
+
+    def reset(self) -> None:
+        """Forget all charges (measurement-phase boundaries: a tamper
+        curve must not inherit the previous window's blocks)."""
+        with self._lock:
+            self._events.clear()
+
+    def charge(self, committee_id, n: int, now: Optional[float] = None) -> None:
+        if not self.enabled() or n <= 0:
+            return
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            q = self._events.setdefault(committee_id, deque())
+            self._prune(q, now)
+            q.append((now, int(n)))
+
+    def blocked(self, committee_id, now: Optional[float] = None) -> Optional[float]:
+        if not self.enabled():
+            return None
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            q = self._events.get(committee_id)
+            if not q:
+                return None
+            self._prune(q, now)
+            if not q:
+                del self._events[committee_id]
+                return None
+            if sum(n for _ts, n in q) <= self.budget:
+                return None
+            # retry once the oldest charge ages out of the window
+            return max(0.1, self.window_s - (now - q[0][0]))

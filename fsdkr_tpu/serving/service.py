@@ -1,5 +1,5 @@
 """RefreshService: the long-running multi-committee serving loop
-(ISSUE 9, ROADMAP open item 1).
+(ISSUE 9, ROADMAP open item 1; chaos-hardened in ISSUE 11).
 
 fs-dkr's refresh is ONE broadcast round, so served throughput is a
 scheduling problem: keep the verify/prove engines saturated while many
@@ -25,8 +25,37 @@ a scheduler:
   (the eks just rotated, so the pool targets must follow).
 
 Lifecycle per session: admitted -> pooled (queued) -> distributing ->
-collecting -> finalizing -> done | aborted, each transition stamped and
-exported through the `fsdkr_serving_*` metrics (serving.metrics).
+collecting -> ready -> finalizing -> done | aborted | timed_out, each
+transition stamped and exported through the `fsdkr_serving_*` metrics
+(serving.metrics). A submission can also be REJECTED at admission
+(overload / bisection-storm shedding, `ServeRejected` with a
+retry-after hint) — a rejection never becomes a session.
+
+## Failure semantics (ISSUE 11)
+
+The service has the failure surface of a fleet component; every
+submitted session reaches exactly one terminal state:
+
+- **done** — verified and adopted; the committee's epoch advanced.
+- **aborted** — a protocol verdict (`FsDkrError`: identifiable-abort
+  blame; never retried — the transcript is the evidence) or a transient
+  infrastructure failure that exhausted its retries (`sess.blame` is
+  False there: infrastructure exhaustion must never read as blame).
+- **timed_out** — the FSDKR_SERVE_DEADLINE_S deadline passed (monotonic
+  reaper). The error names the missing senders when the session was
+  collecting — a quorum gap is identifiable, like abort blame.
+- **rejected** — shed at admission; `submit` raised ServeRejected with
+  a retry-after hint and no session exists.
+
+Transient failures (anything that is NOT an FsDkrError: a dying worker
+thread, a failed finalize launch, injected chaos) retry with jittered
+exponential backoff up to FSDKR_SERVE_RETRIES. Retries are SAFE:
+distribute restarts from scratch before any key mutation, and collect
+is a pure function of the staged public messages until `adopt` (the
+repeated-finalize bit-identity test in tests/test_chaos.py pins this).
+A worker thread killed mid-session (crash isolation) settles only its
+own session and is respawned by its trampoline; the admission queue is
+never wedged.
 
 `FSDKR_SERVE=0` turns the scheduler off: `submit` runs the session
 synchronously through today's single-shot barrier API
@@ -38,8 +67,11 @@ Concurrency rules: at most one in-flight session per committee (a
 refresh mutates the committee's LocalKeys; sessions for one committee
 serialize through the busy flag while other committees proceed), and
 `offer`/`finalize` for one streaming session never race (offers happen
-on the worker before the session is published to the ready list; the
-launcher finalizes only published sessions).
+on the worker or the reaper before the session is published to the
+ready list; the launcher finalizes only published sessions, and marks
+them `finalizing` under the service lock — the reaper never touches a
+`finalizing` session, so `StreamingCollect.close` and a fused finalize
+cannot race either).
 """
 
 from __future__ import annotations
@@ -50,17 +82,29 @@ import threading
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import precompute
 from ..config import ProtocolConfig, DEFAULT_CONFIG
+from ..errors import FsDkrError
 from ..protocol.refresh import RefreshMessage
 from ..protocol.streaming import finalize_streams
-from . import metrics
+from . import faults, metrics
 from .planner import SLO, CapacityPlanner, serve_owner
-from .policy import BatchPolicy
+from .policy import BatchPolicy, BisectGuard, OverloadPolicy, _env_float
 
-__all__ = ["RefreshService", "ServeSession", "enabled"]
+__all__ = [
+    "RefreshService",
+    "ServeSession",
+    "ServeRejected",
+    "SessionTimeout",
+    "enabled",
+]
+
+# terminal session states: _finish is idempotent against them, so a
+# worker, the reaper, and the launcher can settle the same session
+# concurrently and exactly one transition wins
+TERMINAL = ("done", "aborted", "timed_out")
 
 
 def enabled() -> bool:
@@ -94,21 +138,66 @@ def _shuffle_arrivals() -> bool:
     )
 
 
+class ServeRejected(RuntimeError):
+    """submit() shed this request at admission (overload or
+    bisection-storm budget). Carries an honest retry-after hint; the
+    request never became a session, so nothing was spent on it —
+    clients retry with `retry_after_s` the way they would honor a
+    429/Retry-After."""
+
+    def __init__(self, committee_id, retry_after_s: float, reason: str):
+        self.committee_id = committee_id
+        self.retry_after_s = float(retry_after_s)
+        self.reason = reason
+        super().__init__(
+            f"admission rejected for committee {committee_id!r} "
+            f"({reason}); retry after {self.retry_after_s:.2f}s"
+        )
+
+
+class SessionTimeout(RuntimeError):
+    """A session crossed its FSDKR_SERVE_DEADLINE_S deadline. When the
+    session was collecting, `missing` names the senders whose broadcast
+    never arrived — the quorum gap is identifiable, mirroring abort
+    blame (a timed-out session is never confused with a verdict)."""
+
+    def __init__(self, state: str, missing: Sequence[int], waited_s: float):
+        self.state = state
+        self.missing = list(missing)
+        self.waited_s = waited_s
+        detail = f"; missing senders {self.missing}" if self.missing else ""
+        super().__init__(
+            f"session deadline exceeded after {waited_s:.2f}s in state "
+            f"{state!r}{detail}"
+        )
+
+
 @dataclass
 class ServeSession:
     """Public per-session record. Queue/state fields are broadcast-safe
     metadata; the streaming collectors (which hold broadcast messages
     and verdicts) hang off the internal `_streams` and never enter the
-    admission queue."""
+    admission queue. `faults` lists the injected-fault sites that hit
+    this session (site names + sender indices only — chaos-run
+    accounting, never key material)."""
 
     session_id: int
     committee_id: object
     state: str = "admitted"
+    epoch: Optional[int] = None
     submitted_at: float = 0.0
     started_at: float = 0.0
     quorum_at: float = 0.0
     finalized_at: float = 0.0
+    deadline: float = 0.0
+    retries: int = 0
+    blame: bool = False
     error: Optional[str] = None
+    faults: List[str] = field(default_factory=list)
+    _not_before: float = 0.0
+    _pending: List[Tuple[float, object]] = field(
+        default_factory=list, repr=False
+    )
     _streams: list = field(default_factory=list, repr=False)
     _config: Optional[ProtocolConfig] = field(default=None, repr=False)
     _done_evt: threading.Event = field(
@@ -121,7 +210,12 @@ class _Committee:
     keys: list
     config: ProtocolConfig
     slo: SLO
-    busy: bool = False
+    # session id currently holding the one-in-flight-per-committee
+    # slot, or None. Ownership matters: only the holder's settle path
+    # may free it — a reaper timing out a QUEUED sibling must not
+    # release a slot some other live session owns (two concurrent
+    # refreshes would adopt into the same LocalKeys)
+    busy: Optional[int] = None
     epochs: int = 0
 
 
@@ -134,15 +228,39 @@ class RefreshService:
         policy: Optional[BatchPolicy] = None,
         planner: Optional[CapacityPlanner] = None,
         workers: Optional[int] = None,
+        overload: Optional[OverloadPolicy] = None,
+        guard: Optional[BisectGuard] = None,
+        deadline_s: Optional[float] = None,
+        retries: Optional[int] = None,
+        backoff_s: Optional[float] = None,
     ):
         self.policy = policy or BatchPolicy(devices=_device_count())
         self.planner = planner or CapacityPlanner()
+        self.overload = overload or OverloadPolicy()
+        self.guard = guard or BisectGuard()
         if workers is None:
             try:
                 workers = int(os.environ.get("FSDKR_SERVE_WORKERS", "1"))
             except ValueError:
                 workers = 1
         self.workers = max(1, workers)
+        # robustness knobs (ISSUE 11): deadline 0 = no reaper timeouts;
+        # retries bound transient-failure requeues and finalize relaunches
+        self.deadline_s = (
+            deadline_s
+            if deadline_s is not None
+            else _env_float("FSDKR_SERVE_DEADLINE_S", 0.0)
+        )
+        self.retries = (
+            retries
+            if retries is not None
+            else max(0, int(_env_float("FSDKR_SERVE_RETRIES", 2)))
+        )
+        self.backoff_s = (
+            backoff_s
+            if backoff_s is not None
+            else max(0.0, _env_float("FSDKR_SERVE_BACKOFF_MS", 50.0) / 1000.0)
+        )
         self._committees: Dict[object, _Committee] = {}
         # ACTIVE sessions only; finished ones move to the bounded
         # history below so a long-running service cannot grow without
@@ -157,15 +275,35 @@ class RefreshService:
             self._history = 65536
         self._queue: deque = deque()  # session ids, FIFO (public metadata)
         self._ready: List[int] = []  # quorum-ready session ids
+        # failed finalize launches awaiting their backoff: (not-before,
+        # attempt, batch) — requeued, NEVER slept out on the launcher
+        # thread (other committees' ready sessions must not wait behind
+        # one batch's backoff)
+        self._retry_batches: List[Tuple[float, int, List[ServeSession]]] = []
+        # client-retry idempotency: (committee_id, epoch) -> session id
+        self._epoch_index: Dict[Tuple[object, int], int] = {}
         self._lock = threading.Lock()
         self._work_cv = threading.Condition(self._lock)
         self._ready_cv = threading.Condition(self._lock)
+        self._reap_cv = threading.Condition(self._lock)
+        self._idle_cv = threading.Condition(self._lock)
         self._next_id = 0
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self._inflight = 0
         self.sessions_done = 0
         self.sessions_aborted = 0
+        self.sessions_timed_out = 0
+        self.sessions_rejected = 0
+        self.workers_respawned = 0
+        # windowed end-to-end latencies for THIS service's overload
+        # gate (not the cumulative histogram, which never forgets a
+        # storm; not process-global state, which a sibling service
+        # would pollute). The ring turns over with traffic, so the
+        # gate reads the current regime — timeouts included
+        # deliberately: persistent overload producing timeouts is
+        # exactly what should shed. Guarded by self._lock.
+        self._recent_totals: deque = deque(maxlen=256)
 
     # -- committee membership -------------------------------------------
     def admit(
@@ -188,28 +326,88 @@ class RefreshService:
 
     def evict(self, committee_id) -> None:
         """Remove a committee; its pool targets are invalidated and the
-        pooled single-use secrets wiped now (churn discipline)."""
+        pooled single-use secrets wiped now (churn discipline). Its
+        idempotency entries die with it — a committee re-admitted under
+        the same id is a NEW incarnation whose epochs must actually
+        run, not replay a dead predecessor's finished sessions."""
         with self._lock:
             com = self._committees.pop(committee_id, None)
             metrics.committees_gauge().set(len(self._committees))
+            for key in [
+                k for k in self._epoch_index if k[0] == committee_id
+            ]:
+                del self._epoch_index[key]
         if com is not None:
             self.planner.invalidate(committee_id)
 
+    def _measured_p99_s(self) -> float:
+        """Exact p99 over this service's last 256 finished sessions
+        (the overload gate's load signal; 0.0 before any finish).
+        Caller holds self._lock."""
+        if not self._recent_totals:
+            return 0.0
+        vals = sorted(self._recent_totals)
+        return vals[min(len(vals) - 1, int(round(0.99 * (len(vals) - 1))))]
+
     # -- session intake -------------------------------------------------
-    def submit(self, committee_id) -> int:
+    def submit(self, committee_id, epoch: Optional[int] = None) -> int:
         """Enqueue one refresh session for the committee; returns the
         session id. With FSDKR_SERVE=0 the session runs synchronously
-        (single-shot barrier semantics) before this returns."""
+        (single-shot barrier semantics) before this returns.
+
+        `epoch` makes the submission IDEMPOTENT: a resubmission with
+        the same (committee fingerprint, epoch) returns the EXISTING
+        session id — in flight or already finished — instead of
+        enqueuing a double-spend of pooled key bundles. This is the
+        client-retry contract a real ingress needs: retry the same
+        logical refresh freely, observe one session. A FAILED epoch
+        (aborted/timed_out) becomes retryable again — the next submit
+        creates a fresh session. Retention bound: a completed epoch's
+        dedupe entry lives as long as its session stays in the bounded
+        history (FSDKR_SERVE_HISTORY finishes, like an idempotency-key
+        TTL) — a retry arriving later than that re-runs the refresh.
+        Without `epoch` every call is a new session (the pre-ISSUE-11
+        behavior).
+
+        Raises `ServeRejected` (with a retry-after hint) when the
+        overload policy or the committee's bisection-storm budget sheds
+        the request at admission."""
         now = time.monotonic()
         with self._lock:
-            if committee_id not in self._committees:
+            com = self._committees.get(committee_id)
+            if com is None:
                 raise KeyError(f"committee {committee_id!r} not admitted")
+            if epoch is not None:
+                sid = self._epoch_index.get((committee_id, epoch))
+                if sid is not None:
+                    return sid
+            hint, reason = None, ""
+            b = self.guard.blocked(committee_id, now)
+            if b is not None:
+                hint, reason = b, "bisection budget exhausted"
+            if hint is None and self.overload.engaged():
+                h = self.overload.check(
+                    len(self._queue),
+                    self._measured_p99_s(),
+                    com.slo.p99_budget_s,
+                )
+                if h is not None:
+                    hint, reason = h, "overload"
+            if hint is not None:
+                self.sessions_rejected += 1
+                metrics.record_outcome("rejected", 0.0)
+                raise ServeRejected(committee_id, hint, reason)
             self._next_id += 1
             sess = ServeSession(
                 session_id=self._next_id,
                 committee_id=committee_id,
+                epoch=epoch,
                 submitted_at=now,
             )
+            if self.deadline_s > 0:
+                sess.deadline = now + self.deadline_s
+            if epoch is not None:
+                self._epoch_index[(committee_id, epoch)] = sess.session_id
             self._sessions[sess.session_id] = sess
             self._inflight += 1
             metrics.inflight_gauge().set(self._inflight)
@@ -218,12 +416,18 @@ class RefreshService:
                 self._queue.append(sess.session_id)
                 metrics.queue_gauge().set(len(self._queue))
                 self._work_cv.notify()
+                if sess.deadline:
+                    self._reap_cv.notify()
                 return sess.session_id
         # FSDKR_SERVE=0: today's single-shot path, inline
         self._run_single_shot(sess)
         return sess.session_id
 
     def wait(self, session_id: int, timeout: Optional[float] = None) -> ServeSession:
+        """Block until the session reaches a terminal state and return
+        it. Raises `TimeoutError` when `timeout` elapses first — a
+        timeout is DISTINGUISHABLE from completion; this never hands
+        back a possibly-unfinished session (ISSUE 11)."""
         with self._lock:
             sess = self._sessions.get(session_id) or self._finished.get(
                 session_id
@@ -233,20 +437,25 @@ class RefreshService:
                 f"session {session_id} unknown (finished sessions are "
                 f"retained up to FSDKR_SERVE_HISTORY={self._history})"
             )
-        sess._done_evt.wait(timeout)
+        if not sess._done_evt.wait(timeout):
+            raise TimeoutError(
+                f"session {session_id} still {sess.state!r} after "
+                f"{timeout}s"
+            )
         return sess
 
     def drain(self, timeout: float = 60.0) -> bool:
         """Block until every submitted session finished (True) or the
-        timeout elapsed (False)."""
+        timeout elapsed (False). Condition-variable wait — wakes on the
+        final _finish, not on a poll tick."""
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            with self._lock:
-                if self._inflight == 0:
-                    return True
-            time.sleep(0.01)
         with self._lock:
-            return self._inflight == 0
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._idle_cv.wait(timeout=remaining)
+            return True
 
     # -- service threads ------------------------------------------------
     def start(self) -> None:
@@ -255,70 +464,167 @@ class RefreshService:
         self._stop.clear()
         for w in range(self.workers):
             t = threading.Thread(
-                target=self._worker_loop, name=f"fsdkr-serve-worker-{w}",
-                daemon=True,
+                target=self._worker_trampoline, args=(w,),
+                name=f"fsdkr-serve-worker-{w}", daemon=True,
             )
             t.start()
             self._threads.append(t)
-        t = threading.Thread(
-            target=self._launcher_loop, name="fsdkr-serve-launcher", daemon=True
-        )
-        t.start()
-        self._threads.append(t)
+        for target, name in (
+            (self._launcher_loop, "fsdkr-serve-launcher"),
+            (self._reaper_loop, "fsdkr-serve-reaper"),
+        ):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
 
     def stop(self, timeout: float = 10.0) -> None:
         self._stop.set()
         with self._lock:
             self._work_cv.notify_all()
             self._ready_cv.notify_all()
+            self._reap_cv.notify_all()
+            self._idle_cv.notify_all()
         for t in self._threads:
             t.join(timeout=timeout)
         self._threads.clear()
 
     # -- internals: prover/stream side ----------------------------------
-    def _pop_work(self) -> Optional[ServeSession]:
-        """Pop the first queued session whose committee is idle (FIFO
-        per committee; other committees' sessions overtake a busy one)."""
+    def _pop_work(self, now: float):
+        """Under the lock: the first queued session whose committee is
+        idle and whose retry backoff has elapsed (FIFO per committee;
+        other committees' sessions overtake a busy one). Returns
+        (session, None) or (None, seconds-until-next-backoff-expiry)."""
+        next_wake: Optional[float] = None
         for idx, sid in enumerate(self._queue):
-            sess = self._sessions[sid]
+            sess = self._sessions.get(sid)
+            if sess is None or sess.state in TERMINAL:
+                del self._queue[idx]  # reaped while queued
+                return None, 0.0  # rescan immediately
             com = self._committees.get(sess.committee_id)
             if com is None:
                 # evicted mid-queue: abort below, outside the scan
                 del self._queue[idx]
-                return sess
-            if not com.busy:
-                com.busy = True
+                return sess, None
+            if sess._not_before > now:
+                dt = sess._not_before - now
+                next_wake = dt if next_wake is None else min(next_wake, dt)
+                continue
+            if com.busy is None:
+                com.busy = sess.session_id
                 del self._queue[idx]
-                return sess
-        return None
+                return sess, None
+        return None, next_wake
+
+    def _worker_trampoline(self, w: int) -> None:
+        """Crash isolation: a worker whose loop dies (an injected
+        worker crash, or any bug escaping the per-session handler) is
+        respawned here — the failing session was already settled by
+        `_session_failed`, the committee freed, and the admission queue
+        keeps draining. One crash costs one session attempt, never the
+        service."""
+        while not self._stop.is_set():
+            try:
+                self._worker_loop()
+                return  # clean stop
+            except Exception:
+                self.workers_respawned += 1
 
     def _worker_loop(self) -> None:
-        while not self._stop.is_set():
+        while True:
             with self._lock:
-                sess = self._pop_work()
+                if self._stop.is_set():
+                    return
+                sess, wake = self._pop_work(time.monotonic())
                 if sess is None:
-                    self._work_cv.wait(timeout=0.05)
+                    if wake != 0.0:
+                        self._work_cv.wait(timeout=wake)
                     continue
                 metrics.queue_gauge().set(len(self._queue))
                 com = self._committees.get(sess.committee_id)
             if com is None:
-                self._finish(sess, RuntimeError("committee evicted"), time.monotonic())
+                self._finish(
+                    sess, RuntimeError("committee evicted"), time.monotonic()
+                )
                 continue
             try:
                 self._run_session(sess, com)
-            except Exception as e:  # distribute/offer failures
-                with self._lock:
-                    com.busy = False
-                    self._work_cv.notify()
-                self._finish(sess, e, time.monotonic())
+            except Exception as e:  # distribute/offer/injected failures
+                self._session_failed(sess, com, e)
+                if isinstance(e, faults.InjectedWorkerCrash):
+                    raise  # the thread dies; the trampoline respawns it
+
+    def _session_failed(self, sess: ServeSession, com, e: Exception) -> None:
+        """Settle a failed worker attempt: protocol verdicts abort with
+        blame immediately; transient failures requeue with jittered
+        exponential backoff until FSDKR_SERVE_RETRIES is spent."""
+        now = time.monotonic()
+        requeue = False
+        with self._lock:
+            if com is not None and com.busy == sess.session_id:
+                com.busy = None
+                self._work_cv.notify()
+            if sess.state in TERMINAL:
+                return  # the reaper settled it first
+            transient = not isinstance(e, FsDkrError)
+            if transient and sess.retries < self.retries:
+                sess.retries += 1
+                backoff = self.backoff_s * (2 ** (sess.retries - 1))
+                backoff *= 1.0 + random.random()  # jitter: decorrelate herds
+                sess._not_before = now + backoff
+                sess.state = "pooled"
+                sess._streams = []
+                self._queue.append(sess.session_id)
+                metrics.queue_gauge().set(len(self._queue))
+                metrics.retries_counter().inc(stage="worker")
+                self._work_cv.notify()
+                requeue = True
+        if not requeue:
+            self._finish(sess, e, now)
+
+    def _advance(self, sess: ServeSession, state: str) -> bool:
+        """Move a session to a non-terminal lifecycle state, under the
+        lock, UNLESS it already reached a terminal state (the reaper
+        can settle a session while a worker is mid-flight on it; a
+        plain write here would resurrect it and double-finish). False
+        = the session is already settled, the caller must discard its
+        attempt."""
+        with self._lock:
+            if sess.state in TERMINAL:
+                return False
+            sess.state = state
+            return True
 
     def _run_session(self, sess: ServeSession, com: _Committee) -> None:
+        plan = faults.active()
         now = time.monotonic()
         metrics.record_phase("queue", now - sess.submitted_at)
         sess.started_at = now
-        sess.state = "distributing"
+        if not self._advance(sess, "distributing"):
+            return  # reaped while queued; _finish already freed busy
+        if plan and plan.fire("worker_crash", (sess.session_id, sess.retries)):
+            sess.faults.append("worker_crash")
+            raise faults.InjectedWorkerCrash(
+                f"injected worker crash (session {sess.session_id}, "
+                f"attempt {sess.retries})"
+            )
         keys, config = com.keys, com.config
         new_n = len(keys)
+        # roll EVERY broadcast-fault decision up front — decisions are
+        # pure functions of (seed, session, sender index), so they need
+        # no message content — and stamp sess.faults BEFORE distribute:
+        # a deadline firing at any later point can already name the
+        # full dropped-sender set (precedence per message: drop >
+        # tamper > delay > dup)
+        actions: Dict[int, Optional[str]] = {}
+        if plan is not None:
+            for k in keys:
+                pid = k.i
+                for site in ("msg_drop", "msg_tamper", "msg_delay",
+                             "msg_dup"):
+                    if plan.fire(site, (sess.session_id, pid)):
+                        actions[pid] = site
+                        sess.faults.append(f"{site}:{pid}")
+                        break
         owner = serve_owner(sess.committee_id)
         with precompute.owner_scope(owner):
             results = RefreshMessage.distribute_batch(
@@ -328,7 +634,8 @@ class RefreshService:
         metrics.record_phase("distribute", t_dist - now)
 
         msgs = [m for m, _ in results]
-        sess.state = "collecting"
+        if not self._advance(sess, "collecting"):
+            return  # reaped while distributing; attempt discarded
         expected = [k.i for k in keys]
         streams = [
             RefreshMessage.collect_stream(k, results[idx][1], expected, (), config)
@@ -336,33 +643,198 @@ class RefreshService:
         ]
         # simulated broadcast arrival: each message lands at every
         # collector before the next arrives; order is session-seeded so
-        # reordering is exercised continuously in production-like runs
+        # reordering is exercised continuously in production-like runs.
+        # Under a fault plan a message may instead be dropped, tampered
+        # (tampered copy first, honest copy as the corrected duplicate —
+        # first arrival wins), delayed (delivered by the reaper after
+        # delay_s), or duplicated.
         order = list(msgs)
         if _shuffle_arrivals():
             random.Random(sess.session_id).shuffle(order)
+        pending: List[Tuple[float, object]] = []
         for m in order:
+            if sess.state in TERMINAL:
+                break  # reaped mid-arrival: stop burning verify time
+            act = actions.get(m.party_index)
+            if act == "msg_drop":
+                continue
+            if act == "msg_tamper":
+                bad = faults.tamper_message(m)
+                for st in streams:
+                    st.offer(bad)
+                    st.offer(m)  # corrected copy: a late duplicate
+                continue
+            if act == "msg_delay":
+                pending.append((time.monotonic() + plan.delay_s, m))
+                continue
+            if act == "msg_dup":
+                for st in streams:
+                    st.offer(m)
             for st in streams:
                 st.offer(m)
         t_stream = time.monotonic()
         metrics.record_phase("stream", t_stream - t_dist)
 
-        sess._streams = streams
-        sess._config = config
-        sess.quorum_at = t_stream
+        timeout_now = False
         with self._lock:
-            sess.state = "ready"
-            self._ready.append(sess.session_id)
-            self._ready_cv.notify()
+            if sess.state in TERMINAL:
+                # the reaper settled this session while we were
+                # distributing; discard the attempt's streams
+                for st in streams:
+                    st.close(RuntimeError("session already settled"))
+                return
+            sess._streams = streams
+            sess._config = config
+            sess.quorum_at = t_stream
+            if all(st.ready for st in streams):
+                sess.state = "ready"
+                self._ready.append(sess.session_id)
+                self._ready_cv.notify()
+            else:
+                # short of quorum: park for late (delayed) arrivals —
+                # the reaper delivers `pending` and publishes at quorum,
+                # or times the session out at its deadline, naming the
+                # missing senders
+                sess.state = "collecting"
+                sess._pending = pending
+                if pending or sess.deadline:
+                    self._reap_cv.notify()
+                else:
+                    # nothing will ever arrive and no deadline is set:
+                    # settle now instead of wedging (drop faults without
+                    # FSDKR_SERVE_DEADLINE_S must still terminate)
+                    timeout_now = True
+        if timeout_now:
+            self._timeout_session(sess)
+
+    # -- internals: deadline reaper + delayed delivery ------------------
+    def _reaper_loop(self) -> None:
+        """Monotonic-clock timekeeper: delivers delayed broadcast
+        messages when due and moves sessions past their deadline to the
+        `timed_out` terminal state. Never touches a session the
+        launcher already marked `finalizing`."""
+        while True:
+            deliveries: List[Tuple[ServeSession, list]] = []
+            timeouts: List[ServeSession] = []
+            with self._lock:
+                if self._stop.is_set():
+                    return
+                now = time.monotonic()
+                next_wake: Optional[float] = None
+                for sess in list(self._sessions.values()):
+                    if sess.state in TERMINAL or sess.state == "finalizing":
+                        continue
+                    if sess.deadline and now >= sess.deadline:
+                        timeouts.append(sess)
+                        continue
+                    if sess._pending:
+                        due = [m for t, m in sess._pending if t <= now]
+                        if due:
+                            sess._pending = [
+                                (t, m) for t, m in sess._pending if t > now
+                            ]
+                            deliveries.append((sess, due))
+                        for t, _m in sess._pending:
+                            next_wake = (
+                                t if next_wake is None else min(next_wake, t)
+                            )
+                    if sess.deadline:
+                        next_wake = (
+                            sess.deadline
+                            if next_wake is None
+                            else min(next_wake, sess.deadline)
+                        )
+                if not deliveries and not timeouts:
+                    self._reap_cv.wait(
+                        timeout=None if next_wake is None else
+                        max(0.001, next_wake - now)
+                    )
+                    continue
+            # timeouts FIRST: a delivery runs real proof verification
+            # (StreamingCollect.offer) on this thread, and expired
+            # sessions must not wait behind it. Deliveries stay on this
+            # one thread deliberately — it serializes offers per parked
+            # session (offer/finalize must never race) — so a deadline
+            # expiring MID-delivery-batch is observed one batch late;
+            # the lateness is bounded by one wake's delivery work and
+            # only exists under injected msg_delay storms.
+            for sess in timeouts:
+                self._timeout_session(sess)
+            for sess, due in deliveries:
+                for m in due:
+                    for st in sess._streams:
+                        st.offer(m)
+                dead_end = False
+                with self._lock:
+                    if (
+                        sess.state == "collecting"
+                        and sess._streams
+                        and all(st.ready for st in sess._streams)
+                    ):
+                        sess.state = "ready"
+                        sess.quorum_at = time.monotonic()
+                        self._ready.append(sess.session_id)
+                        self._ready_cv.notify()
+                    elif (
+                        sess.state == "collecting"
+                        and not sess._pending
+                        and not sess.deadline
+                    ):
+                        # the last delayed message just landed, the
+                        # session is STILL short of quorum (a dropped
+                        # sender), and no deadline will ever fire:
+                        # settle now instead of wedging
+                        dead_end = True
+                if dead_end:
+                    self._timeout_session(sess)
+
+    def _timeout_session(self, sess: ServeSession) -> None:
+        with self._lock:
+            if sess.state in TERMINAL or sess.state == "finalizing":
+                return
+            try:
+                self._queue.remove(sess.session_id)
+            except ValueError:
+                pass
+            self._ready = [s for s in self._ready if s != sess.session_id]
+            metrics.queue_gauge().set(len(self._queue))
+            # name the quorum gap: senders the collectors are missing,
+            # UNION the drops already rolled for this session (streams
+            # may not be attached yet when the deadline fires mid-offer
+            # — the pre-rolled fault stamps still name the culprits)
+            missing = sorted(
+                {pid for st in sess._streams for pid in st.missing()}
+                | {
+                    int(f.split(":", 1)[1])
+                    for f in sess.faults
+                    if f.startswith("msg_drop:")
+                }
+            )
+            state0 = sess.state
+            waited = time.monotonic() - sess.submitted_at
+            streams = list(sess._streams)
+        err = SessionTimeout(state0, missing, waited)
+        for st in streams:
+            st.close(err)  # late offers -> "late"; staged refs released
+        self._finish(sess, err, time.monotonic(), state="timed_out")
 
     # -- internals: coalescing finalize side ----------------------------
     def _pick_batch(self) -> List[ServeSession]:
         """Under the lock: choose the batch to finalize now (oldest
-        config group, policy-sized), or [] to keep lingering."""
-        if not self._ready:
+        config group, policy-sized), or [] to keep lingering. Sessions
+        the reaper settled while they sat in the ready list are swept
+        out here."""
+        live: List[ServeSession] = []
+        for sid in self._ready:
+            s = self._sessions.get(sid)
+            if s is not None and s.state == "ready":
+                live.append(s)
+        if len(live) != len(self._ready):
+            self._ready = [s.session_id for s in live]
+        if not live:
             return []
         groups: Dict[object, List[ServeSession]] = {}
-        for sid in self._ready:
-            s = self._sessions[sid]
+        for s in live:
             groups.setdefault(s._config, []).append(s)
         # oldest-first: the group containing the longest-waiting session
         group = min(groups.values(), key=lambda g: g[0].quorum_at)
@@ -380,24 +852,91 @@ class RefreshService:
         return batch
 
     def _launcher_loop(self) -> None:
-        while not self._stop.is_set():
+        while True:
             with self._lock:
-                batch = self._pick_batch()
+                if self._stop.is_set():
+                    return
+                now = time.monotonic()
+                batch: List[ServeSession] = []
+                attempt = 0
+                next_retry: Optional[float] = None
+                for i, (due, att, b) in enumerate(self._retry_batches):
+                    if due <= now:
+                        batch, attempt = b, att
+                        del self._retry_batches[i]
+                        break
+                    next_retry = (
+                        due if next_retry is None else min(next_retry, due)
+                    )
                 if not batch:
-                    self._ready_cv.wait(timeout=0.02)
+                    batch = self._pick_batch()
+                    for sess in batch:
+                        sess.state = "finalizing"  # reaper hands-off
+                if not batch:
+                    timeout = None
+                    if self._ready:
+                        oldest = min(
+                            self._sessions[sid].quorum_at
+                            for sid in self._ready
+                        )
+                        timeout = max(
+                            0.005,
+                            self.policy.wait_budget(
+                                time.monotonic() - oldest
+                            ),
+                        )
+                    if next_retry is not None:
+                        dt = max(0.005, next_retry - now)
+                        timeout = dt if timeout is None else min(timeout, dt)
+                    self._ready_cv.wait(timeout=timeout)
                     continue
-            self._finalize_batch(batch)
+            self._finalize_batch(batch, attempt)
 
-    def _finalize_batch(self, batch: List[ServeSession]) -> None:
+    def _finalize_batch(self, batch: List[ServeSession], attempt: int = 0) -> None:
         t0 = time.monotonic()
         config = batch[0]._config
         streams = []
         for sess in batch:
-            sess.state = "finalizing"
-            metrics.record_phase("coalesce", t0 - sess.quorum_at)
+            if attempt == 0:
+                metrics.record_phase("coalesce", t0 - sess.quorum_at)
             streams.extend(sess._streams)
-        metrics.batch_histogram().observe(len(streams))
-        errors = finalize_streams(streams, config)
+        if attempt == 0:
+            metrics.batch_histogram().observe(len(streams))
+        plan = faults.active()
+        bisect0 = metrics.rlc_bisect_count()
+        batch_key = batch[0].session_id
+        try:
+            if plan and plan.fire("finalize_exc", (batch_key, attempt)):
+                for sess in batch:
+                    sess.faults.append("finalize_exc")
+                raise faults.InjectedFinalizeError(
+                    f"injected finalize failure (batch {batch_key}, "
+                    f"attempt {attempt})"
+                )
+            errors = finalize_streams(streams, config)
+        except Exception as e:
+            # a raise here is infrastructure (protocol verdicts come
+            # back in `errors`, isolated per session): retry with
+            # jittered backoff — safe, finalize is pure over the
+            # staged public messages until adoption, and an
+            # already-finalized stream replays its stored verdict. The
+            # batch is REQUEUED with a not-before, never slept out on
+            # this (sole) launcher thread.
+            if attempt >= self.retries:
+                t1 = time.monotonic()
+                for sess in batch:
+                    for st in sess._streams:
+                        st.close(e)
+                    self._finish(sess, e, t1)
+                return
+            metrics.retries_counter().inc(stage="finalize")
+            backoff = self.backoff_s * (2 ** attempt) * (1.0 + random.random())
+            with self._lock:
+                self._retry_batches.append(
+                    (time.monotonic() + backoff, attempt + 1, batch)
+                )
+                self._ready_cv.notify()
+            return
         t1 = time.monotonic()
         pos = 0
         for sess in batch:
@@ -406,38 +945,85 @@ class RefreshService:
             pos += n
             metrics.record_phase("finalize", t1 - t0)
             self._finish(sess, errs[0] if errs else None, t1)
+        # bisection-storm accounting (ROADMAP 5b): bisections in this
+        # launch are the attributable cost of tampered traffic — honest
+        # transcripts bisect zero times — so charge them to the blamed
+        # sessions' committees; over-budget committees are shed at
+        # admission until their window rolls
+        delta = metrics.rlc_bisect_count() - bisect0
+        if delta > 0 and self.guard.enabled():
+            blamed = [s for s in batch if s.blame]
+            if blamed:
+                share = -(-delta // len(blamed))  # ceil-split
+                for s in blamed:
+                    self.guard.charge(s.committee_id, share)
 
-    def _finish(self, sess: ServeSession, error: Optional[Exception], now: float) -> None:
-        sess.finalized_at = now
-        sess._streams = []
-        if error is None:
-            sess.state = "done"
-        else:
-            sess.state = "aborted"
-            sess.error = f"{type(error).__name__}: {error}"
+    def _finish(
+        self,
+        sess: ServeSession,
+        error: Optional[Exception],
+        now: float,
+        state: Optional[str] = None,
+    ) -> None:
+        """Move a session to its terminal state (exactly once: callers
+        may race, the first transition wins) and release every resource
+        it held — committee busy flag, stream references, inflight
+        accounting."""
         with self._lock:
+            if sess.state in TERMINAL:
+                return
+            sess.state = state or ("done" if error is None else "aborted")
+            sess.finalized_at = now
+            sess._streams = []
+            sess._pending = []
+            if error is not None:
+                sess.blame = isinstance(error, FsDkrError)
+                sess.error = f"{type(error).__name__}: {error}"
             com = self._committees.get(sess.committee_id)
             if com is not None:
-                com.busy = False
-                if error is None:
+                # free the slot ONLY if this session holds it: a session
+                # settled while still queued never acquired it, and the
+                # current holder must keep its exclusivity
+                if com.busy == sess.session_id:
+                    com.busy = None
+                    self._work_cv.notify()
+                if sess.state == "done":
                     com.epochs += 1
-                self._work_cv.notify()
             self._inflight -= 1
-            self.sessions_done += error is None
-            self.sessions_aborted += error is not None
+            self.sessions_done += sess.state == "done"
+            self.sessions_aborted += sess.state == "aborted"
+            self.sessions_timed_out += sess.state == "timed_out"
             metrics.inflight_gauge().set(self._inflight)
+            if sess.state != "done" and sess.epoch is not None:
+                # a FAILED epoch must stay retryable: drop the dedupe
+                # entry so the client's next submit(cid, epoch) creates
+                # a fresh session (done sessions keep deduping — that
+                # refresh happened; handing it back is the contract)
+                key = (sess.committee_id, sess.epoch)
+                if self._epoch_index.get(key) == sess.session_id:
+                    del self._epoch_index[key]
             # retire into the bounded history (memory stays O(history))
             self._sessions.pop(sess.session_id, None)
             self._finished[sess.session_id] = sess
             while len(self._finished) > self._history:
-                self._finished.popitem(last=False)
-        metrics.record_outcome(
-            "done" if error is None else "aborted", now - sess.submitted_at
-        )
+                _sid, old = self._finished.popitem(last=False)
+                if old.epoch is not None:
+                    # drop the idempotency entry ONLY if it still maps
+                    # to the evicted session — a failed predecessor may
+                    # have been superseded by a live retry session whose
+                    # mapping must survive
+                    key = (old.committee_id, old.epoch)
+                    if self._epoch_index.get(key) == old.session_id:
+                        del self._epoch_index[key]
+            if self._inflight == 0:
+                self._idle_cv.notify_all()
+            final_state = sess.state
+            self._recent_totals.append(now - sess.submitted_at)
+        metrics.record_outcome(final_state, now - sess.submitted_at)
         # the committee's eks just rotated (or the session died): refresh
         # the SLO-derived pool targets against the live key state and
         # wake the producer — collect's kick has often drained by now
-        if error is None:
+        if final_state == "done":
             self.planner.retarget(sess.committee_id)
             precompute.kick()
         sess._done_evt.set()
@@ -452,21 +1038,34 @@ class RefreshService:
         # same one-session-per-committee rule as the scheduler: a
         # concurrent synchronous submit would race the key mutation
         with self._lock:
-            if com.busy:
+            if com.busy is not None:
                 # un-admit the session before refusing, so the inflight
                 # accounting stays exact
                 self._inflight -= 1
                 self._sessions.pop(sess.session_id, None)
+                if sess.epoch is not None:
+                    self._epoch_index.pop(
+                        (sess.committee_id, sess.epoch), None
+                    )
                 metrics.inflight_gauge().set(self._inflight)
+                if self._inflight == 0:
+                    self._idle_cv.notify_all()
                 raise RuntimeError(
                     "committee busy: the single-shot arm serializes "
                     "sessions per committee in the caller"
                 )
-            com.busy = True
+            com.busy = sess.session_id
         keys, config = com.keys, com.config
         now = time.monotonic()
         sess.started_at = now
-        sess.state = "distributing"
+        if not self._advance(sess, "distributing"):
+            # the reaper settled the session before we started (its
+            # _finish freed the slot; we re-acquired it above)
+            with self._lock:
+                if com.busy == sess.session_id:
+                    com.busy = None
+                    self._work_cv.notify()
+            return
         error: Optional[Exception] = None
         try:
             with precompute.owner_scope(serve_owner(sess.committee_id)):
@@ -474,7 +1073,12 @@ class RefreshService:
                     [(k.i, k) for k in keys], len(keys), config
                 )
             msgs = [m for m, _ in results]
-            sess.state = "collecting"
+            if not self._advance(sess, "collecting"):
+                with self._lock:
+                    if com.busy == sess.session_id:
+                        com.busy = None
+                        self._work_cv.notify()
+                return  # reaped mid-run: never adopt for a settled session
             errs = RefreshMessage.collect_sessions(
                 [(msgs, k, results[idx][1], ()) for idx, k in enumerate(keys)],
                 config,
@@ -495,6 +1099,7 @@ class RefreshService:
                 states[s.state] = states.get(s.state, 0) + 1
             states["done"] = self.sessions_done
             states["aborted"] = self.sessions_aborted
+            states["timed_out"] = self.sessions_timed_out
             return {
                 "committees": len(self._committees),
                 "inflight": self._inflight,
@@ -502,5 +1107,8 @@ class RefreshService:
                 "ready": len(self._ready),
                 "sessions_done": self.sessions_done,
                 "sessions_aborted": self.sessions_aborted,
+                "sessions_timed_out": self.sessions_timed_out,
+                "sessions_rejected": self.sessions_rejected,
+                "workers_respawned": self.workers_respawned,
                 "states": states,
             }

@@ -170,4 +170,43 @@ print("serving smoke leg ok:", rep["sessions_done"], "sessions, p99",
       rep["latency_s"]["p99"], "s, dry", rep["pool"]["dry_fallback_rate"])
 EOF
 
+echo "== test: chaos smoke leg (fault injection, verdict correctness) =="
+# the serving smoke leg above ran perfectly healthy traffic; this leg
+# replays a short Poisson window under a FIXED-SEED fault plan covering
+# every fault class (worker crashes, finalize failures, pool-dry
+# storms, delayed/dropped/duplicated/tampered broadcasts, memory
+# squeezes) and asserts the ISSUE 11 hard invariants on every commit:
+# every class actually injected, zero wedged sessions, zero wrong
+# verdicts (no healthy session blamed, no tampered session clean),
+# every drop-timeout names its missing senders, and the service drains
+rm -f /tmp/fsdkr_ci_chaos.json
+python scripts/loadgen.py --chaos --committees 8 --bases 2 \
+  --window 10 --rate 2.5 --baseline-window 5 --prefill-wait 15 \
+  --deadline 6 --drain-timeout 180 --curve "" --seed 42 \
+  --faults "seed=42,worker_crash=0.35,finalize_exc=0.35,pool_dry=0.08,msg_delay=0.2,msg_drop=0.15,msg_dup=0.25,msg_tamper=0.2,mem_squeeze=0.6,delay_s=0.3,squeeze_factor=0.25" \
+  --tag ci --out /tmp/fsdkr_ci_chaos.json > /dev/null
+python - <<'EOF'
+import json
+rep = json.load(open("/tmp/fsdkr_ci_chaos.json"))
+ch = rep["chaos"]
+missing = [s for s in (
+    "worker_crash", "finalize_exc", "pool_dry", "msg_delay", "msg_drop",
+    "msg_dup", "msg_tamper", "mem_squeeze",
+) if ch["injected"].get(s, 0) < 1]
+assert not missing, f"fault classes never injected: {missing}"
+assert ch["wrong_verdicts"] == 0, ch["outcomes"]["wrong_detail"]
+assert ch["wedged"] == 0, "wedged sessions after drain"
+assert rep["drained"], "service did not drain clean"
+out = ch["outcomes"]
+# wrong_verdicts==0 above already covers dropped-message timeouts that
+# failed to name their missing senders; timeouts of sessions still
+# QUEUED (stuck behind the storm) legitimately have no senders to name
+assert rep["sessions_done"] + rep["sessions_aborted"] \
+    + rep["sessions_timed_out"] == rep["arrivals"], rep["arrivals"]
+dry = rep["pool"]["dry_by_cause"]
+assert dry.get("injected", 0) >= 1, "injected pool-dry storms unlabeled"
+print("chaos smoke leg ok:", dict(ch["injected"]),
+      "| outcomes", {k: v for k, v in out.items() if isinstance(v, int)})
+EOF
+
 echo "== ci.sh: all gates green =="

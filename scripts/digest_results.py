@@ -73,14 +73,16 @@ def main():
     root = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else "bench_results")
     configs, kernels, traces, ec_ab = [], [], {}, []
     mfu, other_kernel_recs = [], 0
-    serving = []
+    serving, chaos = [], []
     # serving reports live both as battery steps (m_serve_*.json) and as
     # the loadgen's own serving_*.json artifacts; the cpu_scale_* /
     # cpu_full_* structural and full-width runs digest too (ISSUE 10),
-    # with reduced-parameter rows labeled as proxies below
+    # with reduced-parameter rows labeled as proxies below; chaos_*.json
+    # are the fault-injection runs (ISSUE 11)
     paths = (
         sorted(root.glob("m_*.json"))
         + sorted(root.glob("serving_*.json"))
+        + sorted(root.glob("chaos_*.json"))
         + sorted(root.glob("cpu_scale_*.json"))
         + sorted(root.glob("cpu_full_*.json"))
     )
@@ -110,6 +112,8 @@ def main():
                 )
                 if not any(f == fp for _n, _r, f in serving):
                     serving.append((name, rec, fp))
+            elif rec.get("metric") == "serve_chaos":
+                chaos.append((name, rec))
             elif "metric" in rec:
                 configs.append((name, rec))
                 if rec.get("trace"):
@@ -319,6 +323,63 @@ def main():
                     f"| {ev.get('wiped', 0)} |"
                 )
             print()
+
+    if chaos:
+        # chaos-hardening runs (ISSUE 11, scripts/loadgen.py --chaos)
+        print("### chaos: serving under fault injection (loadgen --chaos)\n")
+        print("| step | arrivals | done | recovered | aborted (blame/transient) "
+              "| timed out (named) | rejected | wedged | wrong verdicts "
+              "| healthy p99 |")
+        print("|---|---|---|---|---|---|---|---|---|---|")
+        for name, r in chaos:
+            ch = r.get("chaos") or {}
+            out = ch.get("outcomes") or {}
+            p99h = ch.get("p99_healthy_done_s")
+            bnd = ch.get("p99_bound_s")
+            p99s = (
+                f"{p99h}s (bound {bnd}s: "
+                f"{'ok' if ch.get('p99_within_bound') else 'OVER'})"
+                if p99h is not None else "—"
+            )
+            print(
+                f"| {name} | {r.get('arrivals', '—')} "
+                f"| {r.get('sessions_done', '—')} "
+                f"| {out.get('recovered', '—')} "
+                f"| {out.get('aborted_blame', 0)}/"
+                f"{out.get('aborted_transient', 0)} "
+                f"| {out.get('timed_out', 0)} "
+                f"({out.get('timed_out_named', 0)}) "
+                f"| {ch.get('service_rejected_total', r.get('rejected', 0))} "
+                f"| {ch.get('wedged', '—')} "
+                f"| {ch.get('wrong_verdicts', '—')} "
+                f"| {p99s} |"
+            )
+        print()
+        for name, r in chaos:
+            ch = r.get("chaos") or {}
+            inj = ch.get("injected") or {}
+            if inj:
+                print(f"#### injected faults: {name}\n")
+                print("| site | fired |")
+                print("|---|---|")
+                for site in sorted(inj):
+                    print(f"| {site} | {inj[site]} |")
+                print()
+            curve = ch.get("tamper_curve") or []
+            if curve:
+                print(f"#### tamper rate vs bisection cost: {name} "
+                      f"(ROADMAP 5b economics)\n")
+                print("| tamper rate | sessions | aborted | rejected "
+                      "| bisect fallbacks | s/session |")
+                print("|---|---|---|---|---|---|")
+                for pt in curve:
+                    print(
+                        f"| {pt.get('tamper_rate')} | {pt.get('sessions')} "
+                        f"| {pt.get('aborted')} | {pt.get('rejected')} "
+                        f"| {pt.get('bisect_fallbacks')} "
+                        f"| {pt.get('s_per_session')} |"
+                    )
+                print()
 
     if kernels:
         print("### kernel sweep (modexp rows/s, real chip)\n")

@@ -1,0 +1,481 @@
+"""Chaos-hardened serving (ISSUE 11): the fault-injection plan, its
+hook sites, and the service's failure semantics under injection —
+deterministic seed-driven decisions, labeled pool-dry storms, memory
+squeezes, worker crash isolation + respawn, retry-with-backoff for
+transient finalize/worker failures (with the repeated-finalize purity
+pin), the deadline reaper's `timed_out` state naming missing senders,
+idempotent submission, wait() timeout semantics, admission shedding,
+and the bisection-storm guard.
+
+Protocol-level streaming equivalence stays in tests/test_streaming.py;
+here the FAILURE paths are under test.
+"""
+
+import pytest
+
+from fsdkr_tpu import precompute
+from fsdkr_tpu.protocol import RefreshMessage, finalize_streams, simulate_keygen
+from fsdkr_tpu.serving import (
+    SLO,
+    BatchPolicy,
+    BisectGuard,
+    OverloadPolicy,
+    RefreshService,
+    ServeRejected,
+    faults,
+)
+from fsdkr_tpu.serving import metrics as smetrics
+from fsdkr_tpu.telemetry import registry
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    precompute.clear_targets()
+    precompute.clear_pools()
+    yield
+    faults.reset()
+    precompute.clear_targets()
+    precompute.clear_pools()
+
+
+# ---------------------------------------------------------------------------
+# the fault plan
+
+
+def test_fault_plan_parse_and_determinism():
+    plan = faults.FaultPlan.parse(
+        "seed=7, msg_tamper=0.5, worker_crash=1.0, delay_s=0.1, "
+        "pool_dry=0.0, finalize_exc_max=2"
+    )
+    assert plan.seed == 7 and plan.delay_s == 0.1
+    assert plan.caps == {"finalize_exc": 2}
+    # decisions are pure functions of (seed, site, key)
+    a = [plan._roll("msg_tamper", (s, 1)) for s in range(64)]
+    b = [plan._roll("msg_tamper", (s, 1)) for s in range(64)]
+    assert a == b and any(a) and not all(a)  # ~half fire at rate 0.5
+    plan2 = faults.FaultPlan.parse("seed=8,msg_tamper=0.5")
+    assert a != [plan2._roll("msg_tamper", (s, 1)) for s in range(64)]
+    # rate 0 / unlisted sites never fire
+    assert not any(plan._roll("pool_dry", (s,)) for s in range(64))
+    assert not any(plan._roll("msg_drop", (s,)) for s in range(64))
+    # rate 1 always fires
+    assert all(plan.fire("worker_crash", (s,)) for s in range(8))
+
+
+def test_fault_plan_caps_and_accounting():
+    plan = faults.configure("seed=1,finalize_exc=1.0,finalize_exc_max=2")
+    assert faults.active() is plan
+    fired = [plan.fire("finalize_exc", (i,)) for i in range(5)]
+    assert fired == [True, True, False, False, False]  # capped at 2
+    assert plan.injected() == {"finalize_exc": 2}
+    assert registry.counter(
+        "fsdkr_fault_injected", labelnames=("site",)
+    ).value(site="finalize_exc") >= 2
+    faults.reset()
+    assert faults.active() is None
+
+
+def test_fault_plan_unknown_key_rejected():
+    with pytest.raises(ValueError, match="unknown key"):
+        faults.FaultPlan.parse("seed=1,msg_tmaper=0.5")
+    with pytest.raises(ValueError, match="bad entry"):
+        faults.FaultPlan.parse("msg_tamper")
+
+
+def test_fault_plan_env_activation(monkeypatch):
+    monkeypatch.delenv("FSDKR_FAULTS", raising=False)
+    assert faults.active() is None
+    monkeypatch.setenv("FSDKR_FAULTS", "seed=5,pool_dry=1.0")
+    plan = faults.active()
+    assert plan is not None and plan.rates["pool_dry"] == 1.0
+    assert faults.active() is plan  # cached per spec string
+    monkeypatch.setenv("FSDKR_FAULTS", "seed=6,pool_dry=1.0")
+    assert faults.active().seed == 6  # spec change reparsed
+
+
+# ---------------------------------------------------------------------------
+# hook sites outside the service
+
+
+def test_pool_dry_injection_labeled():
+    """ISSUE 11 satellite: injected dry fallbacks are labeled
+    cause=injected (and starve the take WITHOUT consuming the pooled
+    entry); real dries are labeled cause=real — a chaos storm cannot
+    hide a producer regression."""
+    from fsdkr_tpu.precompute import pools
+
+    dry = registry.counter("fsdkr_pool_dry", labelnames=("kind", "cause"))
+    inj0 = dry.value(kind="enc", cause="injected")
+    real0 = dry.value(kind="enc", cause="real")
+    assert pools.put("enc", 31337, (5, 25))
+    faults.configure("seed=2,pool_dry=1.0")
+    assert pools.take("enc", 31337) is None  # starved, entry kept
+    assert dry.value(kind="enc", cause="injected") == inj0 + 1
+    assert dry.value(kind="enc", cause="real") == real0
+    faults.reset()
+    assert pools.take("enc", 31337) == (5, 25)  # entry survived the storm
+    assert pools.take("enc", 31337) is None  # genuinely dry now
+    assert dry.value(kind="enc", cause="real") == real0 + 1
+
+
+def test_mem_squeeze_budget(monkeypatch):
+    from fsdkr_tpu.backend import memplan
+
+    monkeypatch.delenv("FSDKR_MEM_BUDGET_MB", raising=False)
+    full = 256 * (1 << 20)
+    assert memplan.mem_budget_bytes() == full
+    faults.configure("seed=3,mem_squeeze=1.0,squeeze_factor=0.25")
+    assert memplan.mem_budget_bytes() == full // 4
+    faults.reset()
+    assert memplan.mem_budget_bytes() == full
+
+
+# ---------------------------------------------------------------------------
+# service failure semantics
+
+
+def _service(test_config, keys, **kw):
+    kw.setdefault("policy", BatchPolicy(max_sessions=6, linger_s=0.02))
+    kw.setdefault("backoff_s", 0.01)
+    svc = RefreshService(**kw)
+    svc.admit(
+        "com", [k.clone() for k in keys], test_config,
+        SLO(arrival_rate_hz=0.5),
+    )
+    return svc
+
+
+def test_worker_crash_isolation_and_respawn(test_config):
+    """A dying worker thread settles only its own session (no blame:
+    an injected crash is infrastructure, not a verdict), is respawned,
+    and the queue keeps draining: the very next healthy session on the
+    SAME committee completes."""
+    keys = simulate_keygen(1, 3, test_config)
+    svc = _service(test_config, keys, retries=0)
+    try:
+        svc.start()
+        faults.configure("seed=4,worker_crash=1.0")
+        sid = svc.submit("com")
+        assert svc.drain(timeout=30)
+        s = svc.wait(sid, timeout=1)
+        assert s.state == "aborted" and not s.blame
+        assert "InjectedWorkerCrash" in s.error
+        assert "worker_crash" in s.faults
+        assert svc.stats()["workers_respawned"] >= 1
+        faults.reset()
+        sid2 = svc.submit("com")
+        assert svc.drain(timeout=60)
+        assert svc.wait(sid2, timeout=1).state == "done"
+    finally:
+        faults.reset()
+        svc.stop()
+
+
+def test_worker_crash_retry_recovers(test_config):
+    """One injected crash + FSDKR_SERVE_RETRIES>0: the session requeues
+    with backoff and completes — outcome `recovered`, not aborted."""
+    keys = simulate_keygen(1, 3, test_config)
+    svc = _service(test_config, keys, retries=2)
+    try:
+        svc.start()
+        faults.configure("seed=5,worker_crash=1.0,worker_crash_max=1")
+        sid = svc.submit("com")
+        assert svc.drain(timeout=60)
+        s = svc.wait(sid, timeout=1)
+        assert s.state == "done", s.error
+        assert s.retries == 1 and "worker_crash" in s.faults
+    finally:
+        faults.reset()
+        svc.stop()
+
+
+def test_finalize_exc_retry_recovers(test_config):
+    """A failed finalize LAUNCH retries with backoff and completes; the
+    retried finalize is a pure function of the staged public messages,
+    so the committee rotates exactly once, coherently."""
+    keys = simulate_keygen(1, 3, test_config)
+    svc = _service(test_config, keys, retries=2)
+    try:
+        svc.start()
+        faults.configure("seed=6,finalize_exc=1.0,finalize_exc_max=1")
+        r0 = smetrics.retries_counter().value(stage="finalize")
+        sid = svc.submit("com")
+        assert svc.drain(timeout=60)
+        s = svc.wait(sid, timeout=1)
+        assert s.state == "done", s.error
+        assert "finalize_exc" in s.faults
+        assert smetrics.retries_counter().value(stage="finalize") == r0 + 1
+        # post-adopt coherence: one epoch advanced, all parties agree on
+        # the rotated public state (a double or partial adoption would
+        # diverge pk_vec across parties)
+        com = svc._committees["com"]
+        assert com.epochs == 1
+        assert all(k.pk_vec == com.keys[0].pk_vec for k in com.keys)
+    finally:
+        faults.reset()
+        svc.stop()
+
+
+def test_finalize_exhausted_retries_abort_without_blame(test_config):
+    keys = simulate_keygen(1, 3, test_config)
+    svc = _service(test_config, keys, retries=1)
+    try:
+        svc.start()
+        faults.configure("seed=7,finalize_exc=1.0")  # every attempt fails
+        sid = svc.submit("com")
+        assert svc.drain(timeout=60)
+        s = svc.wait(sid, timeout=1)
+        assert s.state == "aborted" and not s.blame
+        assert "InjectedFinalizeError" in s.error
+    finally:
+        faults.reset()
+        svc.stop()
+
+
+def test_repeated_finalize_bit_identity(one_refresh_round, test_config):
+    """The retry-safety pin: a finalize attempt that dies BEFORE the
+    launch (the service's injection point) leaves the streams
+    re-finalizable, the retried finalize mutates the key bit-identically
+    to barrier collect, and any FURTHER finalize only replays the stored
+    verdict — no re-verification, no second adoption."""
+    keys, msgs, dks = one_refresh_round
+    kb, ks = keys[0].clone(), keys[0].clone()
+    RefreshMessage.collect(msgs, kb, dks[0], (), test_config)
+    st = RefreshMessage.collect_stream(
+        ks, dks[0], [m.party_index for m in msgs], (), test_config
+    )
+    for m in msgs:
+        assert st.offer(m) == "accepted"
+    # "attempt 0" failed at launch: nothing touched the streams; the
+    # retry runs the same pure function over the same staged messages
+    assert finalize_streams([st], test_config) == [None]
+    assert ks.keys_linear.x_i.to_int() == kb.keys_linear.x_i.to_int()
+    assert ks.pk_vec == kb.pk_vec
+    assert ks.paillier_dk.p == kb.paillier_dk.p
+    x_once = ks.keys_linear.x_i.to_int()
+    # a third finalize replays the verdict without re-adopting
+    assert finalize_streams([st], test_config) == [None]
+    assert ks.keys_linear.x_i.to_int() == x_once
+
+
+def test_stream_close_semantics(one_refresh_round, test_config):
+    keys, msgs, dks = one_refresh_round
+    st = RefreshMessage.collect_stream(
+        keys[0].clone(), dks[0], [m.party_index for m in msgs], (),
+        test_config,
+    )
+    st.offer(msgs[0])
+    err = RuntimeError("reaped")
+    assert st.close(err) is True
+    assert st.done and st.error is err
+    assert st.offer(msgs[1]) == "late"
+    assert st._pairs == {}  # staged refs released
+    # a fused launch already holding this session replays, never adopts
+    assert finalize_streams([st], test_config) == [err]
+    assert st.close(RuntimeError("again")) is False  # verdict immutable
+    assert st.error is err
+
+
+def test_deadline_reaper_names_missing_senders(test_config):
+    """Dropped broadcasts: the session ends `timed_out` (never wedged),
+    the error NAMES the missing senders (quorum gap is identifiable,
+    like abort blame), and the committee is freed for the next
+    session."""
+    keys = simulate_keygen(1, 3, test_config)
+    # deadline must be comfortably above one healthy session (~1s warm
+    # on this box, more under CPU contention): 4s keeps the follow-up
+    # healthy session from flaking into timed_out on a loaded machine
+    svc = _service(test_config, keys, retries=0, deadline_s=4.0)
+    try:
+        svc.start()
+        faults.configure("seed=8,msg_drop=1.0")  # every broadcast lost
+        sid = svc.submit("com")
+        assert svc.drain(timeout=30)
+        s = svc.wait(sid, timeout=1)
+        assert s.state == "timed_out"
+        assert "missing senders [1, 2, 3]" in s.error, s.error
+        assert any(f.startswith("msg_drop") for f in s.faults)
+        assert svc.stats()["sessions_timed_out"] == 1
+        assert smetrics.sessions_counter().value(outcome="timed_out") >= 1
+        faults.reset()
+        sid2 = svc.submit("com")  # committee not wedged
+        assert svc.drain(timeout=60)
+        assert svc.wait(sid2, timeout=1).state == "done"
+    finally:
+        faults.reset()
+        svc.stop()
+
+
+def test_delayed_broadcast_delivered_by_reaper(test_config):
+    """A delayed message (delay < deadline) is delivered by the reaper
+    and the session completes — out-of-order late arrival is a latency
+    event, not a failure."""
+    keys = simulate_keygen(1, 3, test_config)
+    svc = _service(test_config, keys, retries=0, deadline_s=30.0)
+    try:
+        svc.start()
+        faults.configure(
+            "seed=9,msg_delay=1.0,msg_delay_max=1,delay_s=0.3"
+        )
+        sid = svc.submit("com")
+        assert svc.drain(timeout=60)
+        s = svc.wait(sid, timeout=1)
+        assert s.state == "done", s.error
+        assert any(f.startswith("msg_delay") for f in s.faults)
+    finally:
+        faults.reset()
+        svc.stop()
+
+
+def test_tampered_broadcast_aborts_with_blame(test_config):
+    """Tampered-then-corrected broadcast: first arrival wins, the
+    session aborts with an identifiable FsDkrError — a tampered session
+    can never finish clean, and the blame flag separates it from
+    transient aborts."""
+    keys = simulate_keygen(1, 3, test_config)
+    svc = _service(test_config, keys, retries=2)
+    try:
+        svc.start()
+        faults.configure("seed=10,msg_tamper=1.0,msg_tamper_max=1")
+        sid = svc.submit("com")
+        assert svc.drain(timeout=60)
+        s = svc.wait(sid, timeout=1)
+        assert s.state == "aborted" and s.blame, (s.state, s.error)
+        assert "PDLwSlackProofError" in s.error
+        assert any(f.startswith("msg_tamper") for f in s.faults)
+        assert s.retries == 0  # a verdict is never retried
+    finally:
+        faults.reset()
+        svc.stop()
+
+
+def test_submit_idempotent_on_epoch(test_config):
+    """ISSUE 11 satellite: duplicate submissions keyed by (committee
+    fingerprint, epoch) return the EXISTING session — in flight or
+    finished — instead of double-spending pooled key bundles."""
+    keys = simulate_keygen(1, 3, test_config)
+    svc = _service(test_config, keys)
+    try:
+        svc.start()
+        sid = svc.submit("com", epoch=0)
+        assert svc.submit("com", epoch=0) == sid  # in flight: deduped
+        assert svc.drain(timeout=60)
+        assert svc.wait(sid, timeout=1).state == "done"
+        # finished sessions keep deduping (client retry after success)
+        assert svc.submit("com", epoch=0) == sid
+        sid1 = svc.submit("com", epoch=1)
+        assert sid1 != sid
+        assert svc.drain(timeout=60)
+        assert svc.stats()["sessions_done"] == 2  # exactly two epochs ran
+        # epoch-less submissions keep the legacy always-new behavior
+        assert svc.submit("com") not in (sid, sid1)
+        assert svc.drain(timeout=60)
+    finally:
+        svc.stop()
+
+
+def test_submit_epoch_retryable_after_failure(test_config):
+    """A FAILED epoch must not dedupe forever: the retry contract says
+    timed_out is retryable, so a resubmission of the same (committee,
+    epoch) after a failure creates a FRESH session instead of handing
+    back the dead one."""
+    keys = simulate_keygen(1, 3, test_config)
+    # 4s deadline: see test_deadline_reaper_names_missing_senders
+    svc = _service(test_config, keys, retries=0, deadline_s=4.0)
+    try:
+        svc.start()
+        faults.configure("seed=12,msg_drop=1.0")
+        sid = svc.submit("com", epoch=0)
+        assert svc.drain(timeout=30)
+        assert svc.wait(sid, timeout=1).state == "timed_out"
+        faults.reset()
+        sid2 = svc.submit("com", epoch=0)  # retry: NEW session
+        assert sid2 != sid
+        assert svc.drain(timeout=60)
+        assert svc.wait(sid2, timeout=1).state == "done"
+        assert svc.submit("com", epoch=0) == sid2  # done: dedupes again
+    finally:
+        faults.reset()
+        svc.stop()
+
+
+def test_delayed_plus_dropped_without_deadline_terminates(test_config):
+    """Wedge regression: one message delayed AND one dropped with the
+    deadline OFF — after the reaper delivers the delayed message the
+    session can never reach quorum and must settle as timed_out (naming
+    the dropped sender) instead of hanging forever."""
+    keys = simulate_keygen(1, 3, test_config)
+    svc = _service(test_config, keys, retries=0, deadline_s=0.0)
+    try:
+        svc.start()
+        # precedence per message is drop > tamper > delay > dup, so with
+        # _max=1 caps the first message drops and the second delays
+        faults.configure(
+            "seed=13,msg_drop=1.0,msg_drop_max=1,"
+            "msg_delay=1.0,msg_delay_max=1,delay_s=0.2"
+        )
+        sid = svc.submit("com")
+        assert svc.drain(timeout=30), "delayed+dropped session wedged"
+        s = svc.wait(sid, timeout=1)
+        assert s.state == "timed_out"
+        assert "missing senders" in s.error, s.error
+    finally:
+        faults.reset()
+        svc.stop()
+
+
+def test_wait_timeout_raises(test_config):
+    """ISSUE 11 satellite: wait() never hands back an unfinished
+    session — a timeout raises, distinguishable from completion."""
+    keys = simulate_keygen(1, 3, test_config)
+    svc = _service(test_config, keys)  # never started: nothing runs
+    sid = svc.submit("com")
+    with pytest.raises(TimeoutError, match="pooled"):
+        svc.wait(sid, timeout=0.05)
+    with pytest.raises(KeyError):
+        svc.wait(999999, timeout=0)
+
+
+def test_overload_shed_rejects_with_retry_after(test_config):
+    keys = simulate_keygen(1, 3, test_config)
+    svc = _service(
+        test_config, keys, overload=OverloadPolicy(max_queue=1)
+    )
+    r0 = smetrics.sessions_counter().value(outcome="rejected")
+    svc.submit("com")  # queue depth 0 -> admitted
+    with pytest.raises(ServeRejected) as ei:
+        svc.submit("com")  # queue depth 1 >= max_queue -> shed
+    assert ei.value.retry_after_s > 0
+    assert ei.value.reason == "overload"
+    assert svc.sessions_rejected == 1
+    assert svc.stats()["sessions_rejected"] == 1
+    assert smetrics.sessions_counter().value(outcome="rejected") == r0 + 1
+
+
+def test_bisect_guard_window():
+    g = BisectGuard(budget=2, window_s=1.0)
+    assert g.enabled()
+    assert g.blocked("c", now=100.0) is None
+    g.charge("c", 3, now=100.0)
+    b = g.blocked("c", now=100.1)
+    assert b is not None and 0.8 <= b <= 1.0  # retry when window rolls
+    assert g.blocked("other", now=100.1) is None  # per-committee
+    assert g.blocked("c", now=101.2) is None  # window rolled
+    g.charge("d", 2, now=200.0)  # at budget, not over
+    assert g.blocked("d", now=200.1) is None
+    off = BisectGuard(budget=0)
+    off.charge("c", 99)
+    assert not off.enabled() and off.blocked("c") is None
+
+
+def test_bisect_guard_sheds_submission(test_config):
+    keys = simulate_keygen(1, 3, test_config)
+    svc = _service(
+        test_config, keys, guard=BisectGuard(budget=1, window_s=60.0)
+    )
+    svc.guard.charge("com", 5)  # a tamper storm just cost 5 bisections
+    with pytest.raises(ServeRejected) as ei:
+        svc.submit("com")
+    assert ei.value.reason == "bisection budget exhausted"
+    assert ei.value.retry_after_s > 0

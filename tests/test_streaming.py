@@ -237,3 +237,75 @@ def test_streaming_n16_tamper_blame_identical(committee16, test_config):
     e_s = _stream_err(copy.deepcopy(bad), keys[0].clone(), dks[0], test_config, seed=61)
     assert e_b is not None and e_b[0] == "RangeProofError"
     assert e_s == e_b
+
+
+def test_streaming_n16_adversarial_arrival(committee16, test_config):
+    """ISSUE 11 satellite: adversarial arrival at n=16 — EVERY sender's
+    message arrives twice (duplicate), sender 5's arrives tampered
+    FIRST with the honest copy as the corrected duplicate (first
+    arrival wins, so the tampered transcript is the canonical one), and
+    after finalize every message arrives again (late). Verdict + blame
+    are bit-identical to barrier collect on the accepted message list,
+    and none of the duplicate/late deliveries perturb anything."""
+    keys, msgs, dks = committee16
+    tampered = copy.deepcopy(msgs[4])
+    tampered.range_proofs[2] = dataclasses.replace(
+        tampered.range_proofs[2], s=tampered.range_proofs[2].s + 1
+    )
+    tamper_pid = msgs[4].party_index
+
+    st = RefreshMessage.collect_stream(
+        keys[0].clone(), dks[0], [m.party_index for m in msgs], (),
+        test_config,
+    )
+    order = list(msgs)
+    random.Random(29).shuffle(order)
+    for m in order:
+        if m.party_index == tamper_pid:
+            assert st.offer(tampered) == "accepted"
+            assert st.offer(m) == "duplicate"  # corrected copy: too late
+        else:
+            assert st.offer(m) == "accepted"
+            assert st.offer(m) == "duplicate"
+    assert st.ready
+    try:
+        st.finalize()
+        e_s = None
+    except Exception as e:
+        e_s = _err_key(e)
+    # barrier on the ACCEPTED (canonical) list: honest except sender 5
+    canon = [copy.deepcopy(m) for m in msgs]
+    canon[4] = copy.deepcopy(tampered)
+    e_b = _barrier_err(canon, keys[0].clone(), dks[0], test_config)
+    assert e_b is not None and e_b[0] == "RangeProofError"
+    assert e_s == e_b
+    # late-after-finalize: every sender again, honest and tampered
+    for m in msgs:
+        assert st.offer(m) == "late"
+    assert st.offer(tampered) == "late"
+    assert st.error is not None and _err_key(st.error) == e_b
+
+
+def test_streaming_n16_corrected_first_wins(committee16, test_config):
+    """The mirror case: the HONEST copy arrives first and the tampered
+    copy second (a rejected duplicate) for every sender — the session
+    finishes clean, state-identical to barrier collect on the honest
+    list. An adversary who loses the broadcast race changes nothing."""
+    keys, msgs, dks = committee16
+    kb, ks = keys[1].clone(), keys[1].clone()
+    st = RefreshMessage.collect_stream(
+        ks, dks[1], [m.party_index for m in msgs], (), test_config
+    )
+    order = list(msgs)
+    random.Random(31).shuffle(order)
+    for m in order:
+        assert st.offer(m) == "accepted"
+        bad = copy.deepcopy(m)
+        bad.pdl_proof_vec[0] = dataclasses.replace(
+            bad.pdl_proof_vec[0], s1=bad.pdl_proof_vec[0].s1 + 1
+        )
+        assert st.offer(bad) == "duplicate"  # tampered dup: ignored
+    st.finalize()
+    assert st.error is None
+    RefreshMessage.collect(msgs, kb, dks[1], (), test_config)
+    _assert_keys_equal(kb, ks)
