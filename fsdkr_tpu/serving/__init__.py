@@ -14,8 +14,14 @@ each session synchronously through the unchanged single-shot barrier
 API (`distribute_batch` + `collect_sessions`).
 """
 
+from .journal import Journal, JournalCorruption  # noqa: F401
 from .planner import SLO, CapacityPlanner, serve_owner  # noqa: F401
 from .policy import BatchPolicy, BisectGuard, OverloadPolicy  # noqa: F401
+from .recovery import (  # noqa: F401
+    MemoryKeystore,
+    RecoverySecretsUnavailable,
+    recover,
+)
 from .service import (  # noqa: F401
     RefreshService,
     ServeRejected,
@@ -23,7 +29,7 @@ from .service import (  # noqa: F401
     SessionTimeout,
     enabled,
 )
-from . import faults, metrics  # noqa: F401
+from . import faults, journal, metrics, recovery  # noqa: F401
 
 __all__ = [
     "SLO",
@@ -36,7 +42,14 @@ __all__ = [
     "ServeSession",
     "ServeRejected",
     "SessionTimeout",
+    "Journal",
+    "JournalCorruption",
+    "MemoryKeystore",
+    "RecoverySecretsUnavailable",
+    "recover",
     "enabled",
     "faults",
+    "journal",
     "metrics",
+    "recovery",
 ]

@@ -46,6 +46,17 @@ for every site whose key is schedule-independent):
   counter; verification tiles harder but verdicts are budget-
   independent by the memplan contract).
 
+Process-level sites (ISSUE 12; consulted by the shard supervisor /
+crash-storm load generator and the journal, not by in-process hooks):
+
+- ``shard_kill``     — SIGKILL a live RefreshService shard mid-window
+  (keyed by the storm tick; the supervisor must detect the death,
+  reassign the shard's committees to a peer, and replay its journal).
+- ``journal_torn_write`` — truncate the active journal segment
+  mid-record (keyed by a call counter): a frame header and payload
+  prefix land on disk, exactly the shape a crash mid-write leaves, so
+  the torn-tail replay path is exercised end to end.
+
 ## Zero cost when disabled
 
 Without ``FSDKR_FAULTS`` (and without an explicit `configure()`),
@@ -93,6 +104,8 @@ SITES = (
     "msg_dup",
     "msg_tamper",
     "mem_squeeze",
+    "shard_kill",
+    "journal_torn_write",
 )
 
 _SCALARS = ("seed", "delay_s", "squeeze_factor")

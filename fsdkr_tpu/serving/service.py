@@ -76,6 +76,7 @@ cannot race either).
 
 from __future__ import annotations
 
+import json
 import os
 import random
 import threading
@@ -88,8 +89,10 @@ from .. import precompute
 from ..config import ProtocolConfig, DEFAULT_CONFIG
 from ..errors import FsDkrError
 from ..protocol.refresh import RefreshMessage
+from ..protocol.serialization import refresh_message_to_json
 from ..protocol.streaming import finalize_streams
 from . import faults, metrics
+from .journal import Journal
 from .planner import SLO, CapacityPlanner, serve_owner
 from .policy import BatchPolicy, BisectGuard, OverloadPolicy, _env_float
 
@@ -233,7 +236,26 @@ class RefreshService:
         deadline_s: Optional[float] = None,
         retries: Optional[int] = None,
         backoff_s: Optional[float] = None,
+        journal=None,
+        keystore=None,
     ):
+        # durability (ISSUE 12): `journal` is a serving.journal.Journal
+        # or a directory path; when set, every session's public facts
+        # (admission, accepted broadcasts via the wire codec, terminal
+        # verdicts) are write-ahead logged so serving.recovery can
+        # replay them after process death. `keystore` holds the SECRET
+        # side (committee LocalKeys, per-session new dks) in process
+        # memory only — defaulted so an in-process restart recovers
+        # fully; across real death the session secrets are gone by
+        # design and recovery degrades to retryable transient aborts.
+        if isinstance(journal, (str, os.PathLike)):
+            journal = Journal(journal)
+        self.journal = journal
+        if keystore is None and journal is not None:
+            from .recovery import MemoryKeystore
+
+            keystore = MemoryKeystore()
+        self.keystore = keystore
         self.policy = policy or BatchPolicy(devices=_device_count())
         self.planner = planner or CapacityPlanner()
         self.overload = overload or OverloadPolicy()
@@ -295,6 +317,7 @@ class RefreshService:
         self.sessions_aborted = 0
         self.sessions_timed_out = 0
         self.sessions_rejected = 0
+        self.sessions_replayed = 0
         self.workers_respawned = 0
         # windowed end-to-end latencies for THIS service's overload
         # gate (not the cumulative histogram, which never forgets a
@@ -304,6 +327,62 @@ class RefreshService:
         # deliberately: persistent overload producing timeouts is
         # exactly what should shed. Guarded by self._lock.
         self._recent_totals: deque = deque(maxlen=256)
+
+    # -- journal plumbing (ISSUE 12) ------------------------------------
+    def _jappend(self, rec: dict) -> None:
+        """Append one record when journaling is on. Raises on IO
+        failure — an admission or broadcast that cannot be made durable
+        must fail loudly (the worker retry path treats it as any other
+        transient infrastructure failure)."""
+        if self.journal is not None:
+            self.journal.append(rec)
+
+    def _jappend_safe(self, rec: dict) -> None:
+        """Best-effort append for the TERMINAL path: a dying journal
+        must never leave a finished session's waiters hanging. A
+        swallowed failure means the record is missing from the log, so
+        replay sees the session in-flight and settles it retryably —
+        degraded durability, never a wrong verdict."""
+        try:
+            self._jappend(rec)
+        except Exception:
+            try:
+                from ..telemetry import flight
+
+                flight.record("journal", "terminal_append_failed")
+            except Exception:
+                pass
+
+    def _deposit_dks(self, sess: ServeSession, dks: Sequence) -> None:
+        """Park the session's new decryption keys (party order) in the
+        in-memory keystore so an in-process recovery can resume the
+        session; dropped at terminal. Never serialized, never on
+        disk."""
+        if self.keystore is not None and self.journal is not None:
+            self.keystore.put_session_dks(
+                sess.committee_id, sess.session_id, dks
+            )
+
+    def _offer_all(self, sess: ServeSession, streams, msg, wire=None) -> str:
+        """Offer one broadcast message to every collector of a session
+        and journal it IFF it was accepted (first arrival wins: the
+        accepted copy — tampered or honest — is what replay must
+        re-offer). `wire` lets recovery re-journal the exact bytes it
+        replayed instead of re-serializing."""
+        res = None
+        for st in streams:
+            r = st.offer(msg)
+            res = r if res is None else res
+        if res == "accepted" and self.journal is not None:
+            self._jappend(
+                {
+                    "t": "broadcast",
+                    "sid": sess.session_id,
+                    "sender": msg.party_index,
+                    "wire": wire or refresh_message_to_json(msg),
+                }
+            )
+        return res or "unexpected"
 
     # -- committee membership -------------------------------------------
     def admit(
@@ -315,6 +394,37 @@ class RefreshService:
     ) -> None:
         """Register a committee (its parties' LocalKeys, in index order)
         and install its SLO-derived pool targets."""
+        if self.journal is not None:
+            # the id must survive the wire ROUND-TRIP, not just encode:
+            # a tuple id serializes fine but decodes as an unhashable
+            # list, which would abort the entire replay at recovery —
+            # far too late to discover it
+            try:
+                ok = json.loads(json.dumps(committee_id)) == committee_id
+            except TypeError:
+                ok = False
+            if not ok:
+                raise TypeError(
+                    "journaled committee ids must round-trip through "
+                    "JSON (use str/int ids; got "
+                    f"{type(committee_id).__name__})"
+                )
+            # WAL the committee record BEFORE any in-memory state: a
+            # failed append must leave nothing half-admitted (the
+            # caller can simply retry admit). A duplicate-admit that
+            # fails below leaves a redundant record; replay keys
+            # committees by id, so last-wins is harmless.
+            from .recovery import config_record
+
+            self._jappend(
+                {
+                    "t": "committee",
+                    "cid": committee_id,
+                    "n": len(keys),
+                    "tt": keys[0].t,
+                    "config": config_record(config),
+                }
+            )
         with self._lock:
             if committee_id in self._committees:
                 raise ValueError(f"committee {committee_id!r} already admitted")
@@ -322,6 +432,8 @@ class RefreshService:
                 keys=list(keys), config=config, slo=slo
             )
             metrics.committees_gauge().set(len(self._committees))
+        if self.keystore is not None:
+            self.keystore.put_committee(committee_id, keys)
         self.planner.register(committee_id, keys[0], len(keys), config, slo)
 
     def evict(self, committee_id) -> None:
@@ -339,6 +451,8 @@ class RefreshService:
                 del self._epoch_index[key]
         if com is not None:
             self.planner.invalidate(committee_id)
+        if self.keystore is not None:
+            self.keystore.drop_committee(committee_id)
 
     def _measured_p99_s(self) -> float:
         """Exact p99 over this service's last 256 finished sessions
@@ -406,11 +520,37 @@ class RefreshService:
             )
             if self.deadline_s > 0:
                 sess.deadline = now + self.deadline_s
+            # register fully (dedup index, session table, inflight) but
+            # do NOT make it runnable yet — concurrent duplicate
+            # submits dedupe to it and wait() finds it while we journal
             if epoch is not None:
                 self._epoch_index[(committee_id, epoch)] = sess.session_id
             self._sessions[sess.session_id] = sess
             self._inflight += 1
             metrics.inflight_gauge().set(self._inflight)
+        # WAL the admission OUTSIDE the lock (sync=always fsyncs here —
+        # that must stall only this submitter, not every worker). The
+        # session is not queued yet, so `admitted` still precedes any
+        # `collecting` a worker could journal for it.
+        try:
+            self._jappend(
+                {
+                    "t": "admitted",
+                    "sid": sess.session_id,
+                    "cid": committee_id,
+                    "epoch": epoch,
+                }
+            )
+        except Exception as e:
+            # a session that never became durable never runs — but a
+            # concurrent duplicate submit may already hold its sid (the
+            # dedup index was live while we journaled), so SETTLE it
+            # (_finish: aborted without blame, epoch entry dropped,
+            # waiters woken) instead of vanishing it, then surface the
+            # journal failure to this submitter
+            self._finish(sess, e, time.monotonic())
+            raise
+        with self._lock:
             if enabled():
                 sess.state = "pooled"
                 self._queue.append(sess.session_id)
@@ -487,6 +627,8 @@ class RefreshService:
         for t in self._threads:
             t.join(timeout=timeout)
         self._threads.clear()
+        if self.journal is not None:
+            self.journal.close()
 
     # -- internals: prover/stream side ----------------------------------
     def _pop_work(self, now: float):
@@ -573,6 +715,17 @@ class RefreshService:
                 sess._not_before = now + backoff
                 sess.state = "pooled"
                 sess._streams = []
+                # WAL: the retried attempt re-runs distribute with fresh
+                # randomness, so the failed attempt's journaled
+                # broadcasts (and deposited dks) are stale — a replay
+                # mixing attempts would pair one attempt's messages
+                # with another's secrets. The reset record makes replay
+                # start from the latest attempt only.
+                self._jappend_safe({"t": "reset", "sid": sess.session_id})
+                if self.keystore is not None:
+                    self.keystore.drop_session(
+                        sess.committee_id, sess.session_id
+                    )
                 self._queue.append(sess.session_id)
                 metrics.queue_gauge().set(len(self._queue))
                 metrics.retries_counter().inc(stage="worker")
@@ -637,6 +790,11 @@ class RefreshService:
         if not self._advance(sess, "collecting"):
             return  # reaped while distributing; attempt discarded
         expected = [k.i for k in keys]
+        # secrets to the keystore (memory only), public facts to the WAL
+        self._deposit_dks(sess, [dk for _m, dk in results])
+        self._jappend(
+            {"t": "collecting", "sid": sess.session_id, "expected": expected}
+        )
         streams = [
             RefreshMessage.collect_stream(k, results[idx][1], expected, (), config)
             for idx, k in enumerate(keys)
@@ -660,18 +818,18 @@ class RefreshService:
                 continue
             if act == "msg_tamper":
                 bad = faults.tamper_message(m)
-                for st in streams:
-                    st.offer(bad)
-                    st.offer(m)  # corrected copy: a late duplicate
+                # the TAMPERED copy is what gets accepted (and hence
+                # journaled — replay must reproduce the blame); the
+                # honest copy lands as the corrected duplicate
+                self._offer_all(sess, streams, bad)
+                self._offer_all(sess, streams, m)
                 continue
             if act == "msg_delay":
                 pending.append((time.monotonic() + plan.delay_s, m))
                 continue
             if act == "msg_dup":
-                for st in streams:
-                    st.offer(m)
-            for st in streams:
-                st.offer(m)
+                self._offer_all(sess, streams, m)
+            self._offer_all(sess, streams, m)
         t_stream = time.monotonic()
         metrics.record_phase("stream", t_stream - t_dist)
 
@@ -761,9 +919,18 @@ class RefreshService:
             for sess in timeouts:
                 self._timeout_session(sess)
             for sess, due in deliveries:
-                for m in due:
+                try:
+                    for m in due:
+                        self._offer_all(sess, sess._streams, m)
+                except Exception as e:
+                    # a failing delivery (journal IO, a codec bug) must
+                    # settle the session, never kill the reaper thread;
+                    # close the collectors like every other failure
+                    # path (late offers -> "late", staged refs freed)
                     for st in sess._streams:
-                        st.offer(m)
+                        st.close(e)
+                    self._finish(sess, e, time.monotonic())
+                    continue
                 dead_end = False
                 with self._lock:
                     if (
@@ -1005,20 +1172,26 @@ class RefreshService:
             # retire into the bounded history (memory stays O(history))
             self._sessions.pop(sess.session_id, None)
             self._finished[sess.session_id] = sess
-            while len(self._finished) > self._history:
-                _sid, old = self._finished.popitem(last=False)
-                if old.epoch is not None:
-                    # drop the idempotency entry ONLY if it still maps
-                    # to the evicted session — a failed predecessor may
-                    # have been superseded by a live retry session whose
-                    # mapping must survive
-                    key = (old.committee_id, old.epoch)
-                    if self._epoch_index.get(key) == old.session_id:
-                        del self._epoch_index[key]
+            self._trim_history_locked()
             if self._inflight == 0:
                 self._idle_cv.notify_all()
             final_state = sess.state
             self._recent_totals.append(now - sess.submitted_at)
+        self._jappend_safe(
+            {
+                "t": "terminal",
+                "sid": sess.session_id,
+                "cid": sess.committee_id,
+                "epoch": sess.epoch,
+                "state": final_state,
+                "blame": sess.blame,
+                "error": sess.error,
+            }
+        )
+        if self.keystore is not None:
+            # terminal: the session's new dks are no longer re-derivable
+            # material, they are either adopted or dead — drop them
+            self.keystore.drop_session(sess.committee_id, sess.session_id)
         metrics.record_outcome(final_state, now - sess.submitted_at)
         # the committee's eks just rotated (or the session died): refresh
         # the SLO-derived pool targets against the live key state and
@@ -1027,6 +1200,280 @@ class RefreshService:
             self.planner.retarget(sess.committee_id)
             precompute.kick()
         sess._done_evt.set()
+
+    def _trim_history_locked(self) -> None:
+        """Caller holds self._lock: evict finished sessions past the
+        bounded history, dropping each evicted session's idempotency
+        entry ONLY if it still maps to that session — a failed
+        predecessor may have been superseded by a live retry session
+        whose mapping must survive."""
+        while len(self._finished) > self._history:
+            _sid, old = self._finished.popitem(last=False)
+            if old.epoch is not None:
+                key = (old.committee_id, old.epoch)
+                if self._epoch_index.get(key) == old.session_id:
+                    del self._epoch_index[key]
+
+    # -- recovery surface (ISSUE 12; driven by serving.recovery) --------
+    def has_committee(self, committee_id) -> bool:
+        with self._lock:
+            return committee_id in self._committees
+
+    def committee_size(self, committee_id) -> int:
+        with self._lock:
+            com = self._committees.get(committee_id)
+            return len(com.keys) if com is not None else 0
+
+    def reserve_session_ids(self, max_seen: int) -> None:
+        """Never re-issue a session id a journal already used: a
+        same-directory restart appends new records to the log the NEXT
+        recovery reads, and colliding sids would merge two logical
+        sessions in replay."""
+        with self._lock:
+            self._next_id = max(self._next_id, int(max_seen))
+
+    def restore_terminal(
+        self,
+        committee_id,
+        epoch: Optional[int],
+        state: str,
+        blame: bool,
+        error: Optional[str],
+        rejournal: bool = True,
+    ) -> int:
+        """Replay a journaled terminal verdict verbatim — no recompute,
+        no adoption, no outcome metrics (the work happened in a prior
+        incarnation; `fsdkr_journal_replayed` counts it instead). Done
+        epochs re-enter the idempotency index so `submit(cid, epoch=N)`
+        keeps deduping across the restart. `rejournal=False` skips the
+        self-containment copy — recovery passes it when replaying the
+        service's OWN journal directory, where the record already lives
+        (re-journaling there would double the terminal set on every
+        restart)."""
+        if state not in TERMINAL:
+            raise ValueError(f"not a terminal state: {state!r}")
+        with self._lock:
+            self._next_id += 1
+            sess = ServeSession(
+                session_id=self._next_id,
+                committee_id=committee_id,
+                state=state,
+                epoch=epoch,
+            )
+            now = time.monotonic()
+            sess.submitted_at = sess.finalized_at = now
+            sess.blame = bool(blame)
+            sess.error = error
+            sess._done_evt.set()
+            if state == "done":
+                com = self._committees.get(committee_id)
+                if com is not None:
+                    com.epochs += 1
+                if epoch is not None:
+                    self._epoch_index[(committee_id, epoch)] = sess.session_id
+            self.sessions_replayed += 1
+            self._finished[sess.session_id] = sess
+            self._trim_history_locked()
+        # re-journal into THIS incarnation's log (when it is a
+        # DIFFERENT directory) so the chain stays self-contained: a
+        # second death recovers from this journal alone, without
+        # walking predecessors
+        if rejournal:
+            self._jappend_safe(
+                {
+                    "t": "terminal",
+                    "sid": sess.session_id,
+                    "cid": committee_id,
+                    "epoch": epoch,
+                    "state": state,
+                    "blame": bool(blame),
+                    "error": error,
+                    "replayed": True,
+                }
+            )
+        return sess.session_id
+
+    def _supersede_journaled(
+        self, origin_sid: Optional[int], committee_id, epoch, new_sid: int
+    ) -> None:
+        """Close a journaled predecessor session's log entry once its
+        work has been taken over under a new sid. Without this, a
+        SECOND recovery of the same directory would see the origin sid
+        still in-flight (its keystore dks possibly intact) and re-run
+        it against already-rotated committee keys — a wrong verdict
+        waiting to happen. The origin's dks are dropped with it."""
+        if origin_sid is None:
+            return
+        self._jappend_safe(
+            {
+                "t": "terminal",
+                "sid": origin_sid,
+                "cid": committee_id,
+                "epoch": epoch,
+                "state": "aborted",
+                "blame": False,
+                "error": f"superseded by recovery into session {new_sid}",
+                "replayed": True,
+            }
+        )
+        if self.keystore is not None:
+            self.keystore.drop_session(committee_id, origin_sid)
+
+    def finish_unrecoverable(
+        self,
+        committee_id,
+        epoch: Optional[int],
+        error: Exception,
+        origin_sid: Optional[int] = None,
+    ) -> int:
+        """A journaled in-flight session whose secret state cannot be
+        re-derived: admit it and settle it `aborted` WITHOUT blame in
+        one stroke — the error is not an FsDkrError, so the abort reads
+        transient and the epoch becomes resubmittable (the `_finish`
+        path drops the idempotency entry for non-done epochs). Never a
+        fabricated verdict."""
+        with self._lock:
+            if committee_id not in self._committees:
+                raise KeyError(f"committee {committee_id!r} not admitted")
+            self._next_id += 1
+            sess = ServeSession(
+                session_id=self._next_id,
+                committee_id=committee_id,
+                epoch=epoch,
+                submitted_at=time.monotonic(),
+            )
+            sess.state = "collecting"
+            # best-effort: this whole path is already degraded
+            # durability, and one journal IO failure here must not
+            # abort the caller's replay loop (a lost record just means
+            # the next recovery settles the origin session again)
+            self._jappend_safe(
+                {
+                    "t": "admitted",
+                    "sid": sess.session_id,
+                    "cid": committee_id,
+                    "epoch": epoch,
+                }
+            )
+            if epoch is not None:
+                self._epoch_index[(committee_id, epoch)] = sess.session_id
+            self._sessions[sess.session_id] = sess
+            self._inflight += 1
+            metrics.inflight_gauge().set(self._inflight)
+        self._supersede_journaled(
+            origin_sid, committee_id, epoch, sess.session_id
+        )
+        self._finish(sess, error, time.monotonic())
+        return sess.session_id
+
+    def resume_session(
+        self,
+        committee_id,
+        epoch: Optional[int],
+        dks: Sequence,
+        expected: Sequence[int],
+        broadcasts: Sequence[Tuple[int, str]],
+        origin_sid: Optional[int] = None,
+    ) -> int:
+        """Resume a journaled in-flight session: fresh StreamingCollect
+        collectors from the committee's live LocalKeys + the keystore's
+        re-derived dks, the journaled accepted broadcasts re-offered in
+        acceptance order through the SAME offer path live traffic uses,
+        then back into the ordinary lifecycle (launcher finalize at
+        quorum, reaper deadline otherwise). Verdict + blame are
+        bit-identical to the uninterrupted run by the shared-helper
+        equivalence (tests/test_recovery.py)."""
+        from ..protocol.serialization import refresh_message_from_json
+
+        with self._lock:
+            com = self._committees.get(committee_id)
+            if com is None:
+                raise KeyError(f"committee {committee_id!r} not admitted")
+            if com.busy is not None:
+                raise RuntimeError(
+                    f"committee {committee_id!r} busy during recovery"
+                )
+            self._next_id += 1
+            sess = ServeSession(
+                session_id=self._next_id,
+                committee_id=committee_id,
+                epoch=epoch,
+            )
+            now = time.monotonic()
+            sess.submitted_at = sess.started_at = now
+            if self.deadline_s > 0:
+                sess.deadline = now + self.deadline_s
+            sess.state = "collecting"
+            sess._config = com.config
+            self._jappend(
+                {
+                    "t": "admitted",
+                    "sid": sess.session_id,
+                    "cid": committee_id,
+                    "epoch": epoch,
+                }
+            )
+            if epoch is not None:
+                self._epoch_index[(committee_id, epoch)] = sess.session_id
+            self._sessions[sess.session_id] = sess
+            self._inflight += 1
+            metrics.inflight_gauge().set(self._inflight)
+            com.busy = sess.session_id
+            keys = com.keys
+        # from here on the session owns the committee's busy slot and
+        # the inflight count: ANY failure must settle it through
+        # _finish (which releases both) — raising out of this method
+        # would leak the slot and wedge the committee forever
+        streams = []
+        try:
+            self._jappend(
+                {
+                    "t": "collecting",
+                    "sid": sess.session_id,
+                    "expected": list(expected),
+                }
+            )
+            self._supersede_journaled(
+                origin_sid, committee_id, epoch, sess.session_id
+            )
+            self._deposit_dks(sess, dks)
+            streams = [
+                RefreshMessage.collect_stream(
+                    k, dk, expected, (), sess._config
+                )
+                for k, dk in zip(keys, dks)
+            ]
+            for sender, wire in broadcasts:
+                msg = refresh_message_from_json(wire)
+                self._offer_all(sess, streams, msg, wire=wire)
+        except Exception as e:
+            for st in streams:
+                st.close(e)
+            self._finish(sess, e, time.monotonic())
+            return sess.session_id
+        timeout_now = False
+        with self._lock:
+            if sess.state in TERMINAL:
+                # the deadline fired while the replay offers ran
+                for st in streams:
+                    st.close(RuntimeError("session settled during recovery"))
+                return sess.session_id
+            sess._streams = streams
+            sess.quorum_at = time.monotonic()
+            if all(st.ready for st in streams):
+                sess.state = "ready"
+                self._ready.append(sess.session_id)
+                self._ready_cv.notify()
+            elif sess.deadline:
+                self._reap_cv.notify()
+            else:
+                # short of quorum with no deadline: the journal holds
+                # everything that will ever arrive — settle now, naming
+                # the missing senders, instead of wedging
+                timeout_now = True
+        if timeout_now:
+            self._timeout_session(sess)
+        return sess.session_id
 
     # -- FSDKR_SERVE=0: the single-shot arm -----------------------------
     def _run_single_shot(self, sess: ServeSession) -> None:
@@ -1109,6 +1556,10 @@ class RefreshService:
                 "sessions_aborted": self.sessions_aborted,
                 "sessions_timed_out": self.sessions_timed_out,
                 "sessions_rejected": self.sessions_rejected,
+                "sessions_replayed": self.sessions_replayed,
                 "workers_respawned": self.workers_respawned,
                 "states": states,
             }
+
+    def journal_stats(self) -> Optional[dict]:
+        return self.journal.stats() if self.journal is not None else None

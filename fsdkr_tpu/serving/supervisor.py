@@ -1,0 +1,656 @@
+"""Multi-process shard supervisor (ISSUE 12; ROADMAP 3b): partition
+committees across N `RefreshService` shard processes, health-check them
+by heartbeat, and on shard death reassign its committees to a peer that
+replays the dead shard's journal and resumes.
+
+The ASIC-serving deployments this repo tracks (PAPERS.md,
+arXiv:2604.17808) assume a fleet of shards where individual shard death
+is ROUTINE — the supervisor is the piece that makes that true here:
+
+- **Partitioning**: committees shard by fingerprint
+  (SHA-256 of the committee id, mod shard count) — sessions share
+  nothing across committees but the config-keyed key pool, so the
+  partition is clean. Reassignment after a death overrides the
+  fingerprint (the assignment map, not the hash, is authoritative).
+- **Shards** are child processes of THIS module
+  (``python -m fsdkr_tpu.serving.supervisor --shard ...``), each
+  running one `RefreshService` with its own journal directory and a
+  flight-recorder dump beside it. Parent and child speak JSON lines
+  over stdin/stdout; committee LocalKeys travel over that private pipe
+  (never disk — SECURITY.md "Journal discipline") using the
+  `protocol.serialization` checkpoint codec.
+- **Health**: shards heartbeat every ``hb_interval`` with their
+  serving stats and journal counters, and dump their flight ring to
+  ``<journal_dir>/flight.json`` on every beat — SIGKILL is uncatchable,
+  so the postmortem is the last completed beat, collected by the
+  supervisor at failover. Death is detected by process exit, stdout
+  EOF, or a stale heartbeat.
+- **Failover**: the supervisor re-admits the dead shard's committees
+  on a peer (admission-time key material), sends the peer a ``recover``
+  command for the dead journal directory — terminal verdicts replay
+  verbatim (idempotency index included), in-flight sessions settle
+  ``aborted_transient`` (their new dks died with the shard, and
+  recovery never fabricates a verdict) — then resubmits every pending
+  epoch. The idempotency index makes that safe: a replayed-done epoch
+  dedupes to its stored verdict instantly; a transiently-aborted epoch
+  re-runs. MTTR is measured from death detection to the first pending
+  epoch of that shard resolving.
+
+Aggregate `fsdkr_serving_*` / `fsdkr_journal_*` readings across shards
+come from the heartbeats (`ShardSupervisor.aggregate`).
+
+The kill-storm harness on top of this lives in
+``scripts/loadgen.py --crash-storm``; the deterministic 2-shard
+SIGKILL/recovery smoke is a ci.sh leg.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import pathlib
+import queue
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["ShardSupervisor", "ShardHandle", "shard_for"]
+
+
+def shard_for(committee_id, shards: int) -> int:
+    """Fingerprint partition (ROADMAP 3b): stable across processes —
+    SHA-256 of the canonical JSON id, never Python's salted hash()."""
+    h = hashlib.sha256(
+        json.dumps(committee_id, sort_keys=True).encode()
+    ).digest()
+    return int.from_bytes(h[:8], "big") % max(1, shards)
+
+
+# ---------------------------------------------------------------------------
+# shard child process
+
+
+def _emit(lock: threading.Lock, obj: dict) -> None:
+    with lock:
+        sys.stdout.write(json.dumps(obj, default=str) + "\n")
+        sys.stdout.flush()
+
+
+def _shard_main(args) -> int:
+    """One shard: a RefreshService with a journal, driven by JSON-line
+    commands on stdin, reporting events on stdout. Runs until stdin
+    closes or a ``stop`` command arrives."""
+    from ..protocol.serialization import local_key_from_json
+    from ..telemetry import flight
+    from . import recovery
+    from .service import RefreshService, ServeRejected
+
+    out_lock = threading.Lock()
+    svc = RefreshService(
+        journal=args.journal_dir,
+        deadline_s=args.deadline,
+        retries=args.retries,
+        workers=args.workers,
+    )
+    svc.start()
+    stop_evt = threading.Event()
+
+    def heartbeat():
+        while not stop_evt.wait(args.hb_interval):
+            try:
+                flight.dump(reason="heartbeat")  # postmortem-in-waiting
+            except Exception:
+                pass
+            _emit(out_lock, {
+                "ev": "hb",
+                "shard": args.shard_id,
+                "stats": svc.stats(),
+                "journal": svc.journal_stats(),
+            })
+
+    def waiter(cid, epoch, sid):
+        s = svc.wait(sid)  # blocks until terminal
+        _emit(out_lock, {
+            "ev": "terminal",
+            "shard": args.shard_id,
+            "cid": cid,
+            "epoch": epoch,
+            "sid": sid,
+            "state": s.state,
+            "blame": s.blame,
+            "error": s.error,
+            "latency_s": round(
+                max(0.0, s.finalized_at - s.submitted_at), 4
+            ),
+            "retries": s.retries,
+        })
+
+    threading.Thread(target=heartbeat, daemon=True, name="shard-hb").start()
+    _emit(out_lock, {"ev": "ready", "shard": args.shard_id, "pid": os.getpid()})
+
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            cmd = json.loads(line)
+        except ValueError:
+            _emit(out_lock, {"ev": "error", "detail": "bad command json"})
+            continue
+        op = cmd.get("cmd")
+        try:
+            if op == "admit":
+                cid = cmd["cid"]
+                if not svc.has_committee(cid):
+                    keys = [local_key_from_json(k) for k in cmd["keys"]]
+                    svc.admit(
+                        cid, keys, recovery.config_from_record(cmd["config"])
+                    )
+                _emit(out_lock, {"ev": "admitted", "shard": args.shard_id,
+                                 "cid": cid})
+            elif op == "submit":
+                cid, epoch = cmd["cid"], cmd.get("epoch")
+                try:
+                    sid = svc.submit(cid, epoch=epoch)
+                except ServeRejected as e:
+                    _emit(out_lock, {
+                        "ev": "rejected", "shard": args.shard_id,
+                        "cid": cid, "epoch": epoch,
+                        "retry_after_s": e.retry_after_s,
+                    })
+                    continue
+                threading.Thread(
+                    target=waiter, args=(cid, epoch, sid), daemon=True
+                ).start()
+            elif op == "recover":
+                flight.record("recovery", "peer_journal_adopted",
+                              dir=str(cmd["dir"]))
+                report = recovery.recover(svc, cmd["dir"], svc.keystore)
+                _emit(out_lock, {"ev": "recovered", "shard": args.shard_id,
+                                 "report": report})
+            elif op == "sync":
+                if svc.journal is not None:
+                    svc.journal.sync()
+                _emit(out_lock, {"ev": "synced", "shard": args.shard_id})
+            elif op == "stop":
+                break
+            else:
+                _emit(out_lock, {"ev": "error", "detail": f"unknown cmd {op!r}"})
+        except Exception as e:  # a failing command must not kill the shard
+            _emit(out_lock, {
+                "ev": "error", "shard": args.shard_id, "cmd": op,
+                "detail": f"{type(e).__name__}: {e}",
+            })
+    stop_evt.set()
+    svc.stop()
+    try:
+        flight.dump(reason="shard-exit")
+    except Exception:
+        pass
+    _emit(out_lock, {"ev": "stopped", "shard": args.shard_id})
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent side
+
+
+class ShardHandle:
+    def __init__(self, idx: int, proc, journal_dir: pathlib.Path):
+        self.idx = idx
+        self.proc = proc
+        self.journal_dir = journal_dir
+        self.flight_path = journal_dir / "flight.json"
+        self.stderr_path = journal_dir / "stderr.log"
+        self.alive = True
+        self.ready = False
+        self.stopped = False  # clean shutdown acknowledged
+        self.failed_over = False  # death already handled
+        self.last_hb = time.monotonic()
+        self.last_stats: dict = {}
+        self.last_journal: dict = {}
+        self.committees: set = set()
+
+
+class ShardSupervisor:
+    """Parent-side fleet controller. Construct, `start()`, `admit` and
+    `submit` committees/epochs, call `pump()` from the driving loop (it
+    drains shard events AND runs health checks / failover), `drain()`
+    for quiescence, `stop()` to tear down. `outcomes` accumulates one
+    record per resolved (committee, epoch)."""
+
+    def __init__(
+        self,
+        shards: int = 2,
+        root=None,
+        deadline_s: float = 10.0,
+        retries: int = 2,
+        workers: int = 1,
+        hb_interval: float = 0.5,
+        hb_timeout: Optional[float] = None,
+        spawn_timeout: float = 240.0,
+        max_resubmits: int = 2,
+        env: Optional[dict] = None,
+    ):
+        self.n_shards = max(1, int(shards))
+        self.root = pathlib.Path(root) if root else pathlib.Path(
+            ".fsdkr_shards"
+        )
+        self.deadline_s = deadline_s
+        self.retries = retries
+        self.workers = workers
+        self.hb_interval = hb_interval
+        self.hb_timeout = hb_timeout or max(5.0, 8 * hb_interval)
+        self.spawn_timeout = spawn_timeout
+        self.max_resubmits = max_resubmits
+        self.extra_env = dict(env or {})
+        self.shards: List[ShardHandle] = []
+        self.events: "queue.Queue[Tuple[int, dict]]" = queue.Queue()
+        self.assignment: Dict[object, int] = {}
+        self._admissions: Dict[object, Tuple[list, dict]] = {}
+        # (cid, epoch) -> pending record; resolved ones move to outcomes
+        self.pending: Dict[Tuple[object, Optional[int]], dict] = {}
+        self.outcomes: List[dict] = []
+        self.failovers: List[dict] = []
+        self.kills = 0
+        self._gen = 0  # failover generation, for MTTR attribution
+        self._stopping = False
+        # single-threaded by contract: pending/outcomes/assignment are
+        # touched only from the thread driving pump()/submit(); the
+        # reader threads just enqueue onto self.events
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        self.root.mkdir(parents=True, exist_ok=True)
+        for i in range(self.n_shards):
+            self.shards.append(self._spawn(i))
+        deadline = time.monotonic() + self.spawn_timeout
+        while time.monotonic() < deadline:
+            self.pump(0.2, health=False)
+            if all(h.ready for h in self.shards):
+                return
+        missing = [h.idx for h in self.shards if not h.ready]
+        raise RuntimeError(f"shards never became ready: {missing}")
+
+    def _spawn(self, idx: int) -> ShardHandle:
+        jdir = self.root / f"shard{idx:02d}"
+        jdir.mkdir(parents=True, exist_ok=True)
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["FSDKR_FLIGHT"] = str(jdir / "flight.json")
+        stderr = open(jdir / "stderr.log", "ab")
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "fsdkr_tpu.serving.supervisor",
+                "--shard", "--shard-id", str(idx),
+                "--journal-dir", str(jdir),
+                "--deadline", str(self.deadline_s),
+                "--retries", str(self.retries),
+                "--workers", str(self.workers),
+                "--hb-interval", str(self.hb_interval),
+            ],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=stderr,
+            text=True,
+            env=env,
+            cwd=str(pathlib.Path(__file__).resolve().parents[2]),
+        )
+        stderr.close()
+        handle = ShardHandle(idx, proc, jdir)
+        threading.Thread(
+            target=self._reader, args=(handle,), daemon=True,
+            name=f"shard{idx}-reader",
+        ).start()
+        return handle
+
+    def _reader(self, handle: ShardHandle) -> None:
+        for line in handle.proc.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                self.events.put((handle.idx, json.loads(line)))
+            except ValueError:
+                continue  # non-protocol noise on stdout
+        self.events.put((handle.idx, {"ev": "_eof"}))
+
+    def stop(self) -> None:
+        self._stopping = True
+        for h in self.shards:
+            if h.alive:
+                self._send(h, {"cmd": "stop"})
+        for h in self.shards:
+            try:
+                h.proc.wait(timeout=10)
+            except Exception:
+                h.proc.kill()
+
+    # -- plumbing -------------------------------------------------------
+    def _send(self, handle: ShardHandle, obj: dict) -> bool:
+        try:
+            handle.proc.stdin.write(json.dumps(obj, default=str) + "\n")
+            handle.proc.stdin.flush()
+            return True
+        except Exception:
+            # a broken pipe IS a death signal — route it through the
+            # same one-shot death handler as EOF and the health check,
+            # or the shard's committees would wedge un-failed-over
+            self._on_death(handle)
+            return False
+
+    def _on_death(self, handle: ShardHandle) -> None:
+        """One-shot death handling shared by every detection path
+        (stdout EOF, broken stdin pipe, process exit, stale heartbeat):
+        mark the shard dead and fail its committees over exactly once.
+        Clean shutdowns (acked `stopped`, or supervisor stop() in
+        progress) never failover."""
+        handle.alive = False
+        if self._stopping or handle.stopped or handle.failed_over:
+            return
+        handle.failed_over = True
+        self._failover(handle)
+
+    def _alive(self) -> List[ShardHandle]:
+        return [h for h in self.shards if h.alive]
+
+    # -- committee / session intake -------------------------------------
+    def admit(self, committee_id, keys, config) -> None:
+        """Admit a committee fleet-wide: serialize its LocalKeys once
+        (the failover re-admission source) and route to the fingerprint
+        shard."""
+        from ..protocol.serialization import local_key_to_json
+        from .recovery import config_record
+
+        wire = [local_key_to_json(k) for k in keys]
+        crec = config_record(config)
+        self._admissions[committee_id] = (wire, crec)
+        owner = shard_for(committee_id, self.n_shards)
+        if not self.shards[owner].alive:
+            owner = self._peer_for(owner)
+        self.assignment[committee_id] = owner
+        self.shards[owner].committees.add(committee_id)
+        self._send(self.shards[owner], {
+            "cmd": "admit", "cid": committee_id, "keys": wire,
+            "config": crec,
+        })
+
+    def submit(self, committee_id, epoch: Optional[int]) -> None:
+        owner = self.assignment[committee_id]
+        key = (committee_id, epoch)
+        if key not in self.pending:
+            self.pending[key] = {
+                "shard": owner,
+                "t0": time.monotonic(),
+                "via": "primary",
+                "resubmits": 0,
+                "gen": None,
+            }
+        self._send(self.shards[owner], {
+            "cmd": "submit", "cid": committee_id, "epoch": epoch,
+        })
+
+    # -- event / health loop --------------------------------------------
+    def pump(self, max_wait: float = 0.1, health: bool = True) -> None:
+        """Drain shard events (blocking up to `max_wait` for the first)
+        and run the health check. Call this from the driving loop."""
+        deadline = time.monotonic() + max_wait
+        block = max_wait
+        while True:
+            try:
+                idx, ev = self.events.get(timeout=max(0.0, block))
+            except queue.Empty:
+                break
+            self._on_event(idx, ev)
+            block = deadline - time.monotonic()
+            if block <= 0:
+                # drain whatever is already queued, without blocking
+                while True:
+                    try:
+                        idx, ev = self.events.get_nowait()
+                    except queue.Empty:
+                        break
+                    self._on_event(idx, ev)
+                break
+        if health:
+            self.check_health()
+
+    def _on_event(self, idx: int, ev: dict) -> None:
+        h = self.shards[idx]
+        kind = ev.get("ev")
+        if kind == "ready":
+            h.ready = True
+            h.last_hb = time.monotonic()
+        elif kind == "hb":
+            h.last_hb = time.monotonic()
+            h.last_stats = ev.get("stats") or {}
+            h.last_journal = ev.get("journal") or {}
+        elif kind == "terminal":
+            self._resolve(idx, ev)
+        elif kind == "rejected":
+            key = (ev.get("cid"), ev.get("epoch"))
+            pend = self.pending.pop(key, None)
+            if pend is not None:
+                self.outcomes.append({
+                    "cid": ev.get("cid"), "epoch": ev.get("epoch"),
+                    "state": "rejected", "blame": False, "error": None,
+                    "latency_s": None, "via": pend["via"], "shard": idx,
+                })
+        elif kind == "recovered":
+            for fo in self.failovers:
+                if fo.get("peer") == idx and "recovery" not in fo:
+                    rep = ev.get("report") or {}
+                    rep.pop("sessions", None)
+                    fo["recovery"] = rep
+                    # replay latency: death detection -> the peer
+                    # finished adopting the journal (MTTR proper also
+                    # needs an interrupted epoch to complete; this is
+                    # the floor every failover pays)
+                    fo["recover_s"] = round(
+                        time.monotonic() - fo["detected_mono"], 4
+                    )
+                    break
+        elif kind == "stopped":
+            h.stopped = True
+        elif kind == "_eof":
+            # stdout EOF is the fastest death signal (a SIGKILL closes
+            # the pipe immediately, long before the heartbeat staleness
+            # window); a clean shutdown acked `stopped` first
+            self._on_death(h)
+
+    def _resolve(self, idx: int, ev: dict) -> None:
+        key = (ev.get("cid"), ev.get("epoch"))
+        pend = self.pending.get(key)
+        if pend is None:
+            return  # duplicate terminal for an already-resolved epoch
+        state, blame = ev.get("state"), bool(ev.get("blame"))
+        transient_failure = state in ("aborted", "timed_out") and not blame
+        if transient_failure and pend["resubmits"] < self.max_resubmits:
+            # the retry contract: transient failures (including
+            # recovery's aborted_transient) are resubmittable — the
+            # epoch index guarantees at most one effective run
+            pend["resubmits"] += 1
+            pend["via"] = "resubmit"
+            owner = self.assignment[key[0]]
+            self._send(self.shards[owner], {
+                "cmd": "submit", "cid": key[0], "epoch": key[1],
+            })
+            return
+        del self.pending[key]
+        out = {
+            "cid": key[0], "epoch": key[1], "state": state, "blame": blame,
+            "error": ev.get("error"), "latency_s": ev.get("latency_s"),
+            "total_s": round(time.monotonic() - pend["t0"], 4),
+            "via": pend["via"], "resubmits": pend["resubmits"],
+            "shard": idx,
+        }
+        self.outcomes.append(out)
+        if pend.get("gen") is not None:
+            for fo in self.failovers:
+                if fo["gen"] == pend["gen"] and fo.get("mttr_s") is None:
+                    fo["mttr_s"] = round(
+                        time.monotonic() - fo["detected_mono"], 4
+                    )
+
+    def check_health(self) -> None:
+        now = time.monotonic()
+        for h in self.shards:
+            if not h.alive:
+                continue
+            dead = h.proc.poll() is not None or (
+                h.ready and now - h.last_hb > self.hb_timeout
+            )
+            if dead:
+                self._on_death(h)
+
+    def _peer_for(self, dead_idx: int) -> int:
+        alive = [h.idx for h in self._alive()]
+        if not alive:
+            raise RuntimeError("no live shard left to adopt committees")
+        # deterministic: the next live shard after the dead one
+        for off in range(1, self.n_shards):
+            cand = (dead_idx + off) % self.n_shards
+            if cand in alive:
+                return cand
+        return alive[0]
+
+    def _failover(self, dead: ShardHandle) -> None:
+        """Reassign the dead shard's committees to a peer, replay its
+        journal there, resubmit its pending epochs."""
+        detected = time.monotonic()
+        self._gen += 1
+        gen = self._gen
+        try:
+            from ..telemetry import flight
+
+            flight.record(
+                "supervisor", "shard_death", shard=dead.idx, gen=gen
+            )
+        except Exception:
+            pass
+        peer = self.shards[self._peer_for(dead.idx)]
+        fo = {
+            "gen": gen,
+            "dead": dead.idx,
+            "peer": peer.idx,
+            "detected_mono": detected,
+            "detected_wall": time.time(),
+            "committees": len(dead.committees),
+            "journal_dir": str(dead.journal_dir),
+            # the dead shard's postmortem: its last completed heartbeat
+            # flight dump, collected beside its journal
+            "flight_dump": (
+                str(dead.flight_path) if dead.flight_path.exists() else None
+            ),
+            "mttr_s": None,
+        }
+        self.failovers.append(fo)
+        moved = sorted(dead.committees, key=str)
+        fo["moved"] = list(moved)
+        for cid in moved:
+            wire, crec = self._admissions[cid]
+            self._send(peer, {
+                "cmd": "admit", "cid": cid, "keys": wire, "config": crec,
+            })
+            self.assignment[cid] = peer.idx
+            peer.committees.add(cid)
+        dead.committees.clear()
+        self._send(peer, {"cmd": "recover", "dir": str(dead.journal_dir)})
+        # resubmit every unresolved epoch the dead shard owned; the
+        # peer's restored idempotency index replays done epochs
+        # instantly and re-runs transient ones
+        moved_set = set(moved)
+        for (cid, epoch), pend in list(self.pending.items()):
+            if cid not in moved_set:
+                continue
+            pend["shard"] = peer.idx
+            pend["via"] = "failover"
+            pend["gen"] = gen
+            self._send(peer, {
+                "cmd": "submit", "cid": cid, "epoch": epoch,
+            })
+
+    # -- chaos ----------------------------------------------------------
+    def kill_shard(self, idx: Optional[int] = None) -> Optional[int]:
+        """SIGKILL a live shard (the `shard_kill` fault site acts
+        through here). Returns the killed index, or None when no victim
+        is available (never kill the last shard standing)."""
+        alive = self._alive()
+        if len(alive) < 2:
+            return None
+        victim = None
+        for h in alive:
+            if idx is None or h.idx == idx:
+                victim = h
+                break
+        if victim is None:
+            return None
+        try:
+            os.kill(victim.proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        self.kills += 1
+        return victim.idx
+
+    # -- quiescence / reporting -----------------------------------------
+    def drain(self, timeout: float = 120.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while self.pending and time.monotonic() < deadline:
+            self.pump(0.2)
+        return not self.pending
+
+    def aggregate(self) -> dict:
+        """Fleet-wide rollup from the last heartbeats (dead shards
+        contribute their final beat — the aggregate survives kills)."""
+        agg: Dict[str, float] = {}
+        jagg: Dict[str, float] = {}
+        for h in self.shards:
+            for k, v in (h.last_stats or {}).items():
+                if isinstance(v, (int, float)):
+                    agg[k] = agg.get(k, 0) + v
+            for k, v in (h.last_journal or {}).items():
+                if isinstance(v, (int, float)):
+                    jagg[k] = jagg.get(k, 0) + v
+        return {
+            "shards": self.n_shards,
+            "alive": len(self._alive()),
+            "kills": self.kills,
+            "failovers": [
+                {k: v for k, v in fo.items() if k != "detected_mono"}
+                for fo in self.failovers
+            ],
+            "serving": agg,
+            "journal": jagg,
+        }
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--shard", action="store_true",
+                   help="run as a shard child process (internal)")
+    p.add_argument("--shard-id", type=int, default=0)
+    p.add_argument("--journal-dir", default=None)
+    p.add_argument("--deadline", type=float, default=10.0)
+    p.add_argument("--retries", type=int, default=2)
+    p.add_argument("--workers", type=int, default=1)
+    p.add_argument("--hb-interval", type=float, default=0.5)
+    args = p.parse_args(argv)
+    if not args.shard:
+        p.error("supervisor is a library; only --shard mode runs directly "
+                "(use ShardSupervisor or scripts/loadgen.py --crash-storm)")
+    if not args.journal_dir:
+        p.error("--journal-dir is required in --shard mode")
+    return _shard_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,7 +73,7 @@ def main():
     root = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else "bench_results")
     configs, kernels, traces, ec_ab = [], [], {}, []
     mfu, other_kernel_recs = [], 0
-    serving, chaos = [], []
+    serving, chaos, storms = [], [], []
     # serving reports live both as battery steps (m_serve_*.json) and as
     # the loadgen's own serving_*.json artifacts; the cpu_scale_* /
     # cpu_full_* structural and full-width runs digest too (ISSUE 10),
@@ -83,6 +83,7 @@ def main():
         sorted(root.glob("m_*.json"))
         + sorted(root.glob("serving_*.json"))
         + sorted(root.glob("chaos_*.json"))
+        + sorted(root.glob("crash_storm*.json"))
         + sorted(root.glob("cpu_scale_*.json"))
         + sorted(root.glob("cpu_full_*.json"))
     )
@@ -114,6 +115,8 @@ def main():
                     serving.append((name, rec, fp))
             elif rec.get("metric") == "serve_chaos":
                 chaos.append((name, rec))
+            elif rec.get("metric") == "serve_crash_storm":
+                storms.append((name, rec))
             elif "metric" in rec:
                 configs.append((name, rec))
                 if rec.get("trace"):
@@ -380,6 +383,68 @@ def main():
                         f"| {pt.get('s_per_session')} |"
                     )
                 print()
+
+    if storms:
+        # crash-storm / shard-kill recovery runs (ISSUE 12,
+        # scripts/loadgen.py --crash-storm)
+        print("### crash storm: durable sessions under shard kills "
+              "(loadgen --crash-storm)\n")
+        print("| step | shards | kills | epochs | clean | recovered "
+              "| transient | lost | wrong | wedged | MTTR mean/max "
+              "| bystander p99 | gates |")
+        print("|---|---|---|---|---|---|---|---|---|---|---|---|---|")
+        for name, r in storms:
+            out = r.get("outcomes") or {}
+            mttr = r.get("mttr_s") or {}
+            gates = r.get("gates") or {}
+            gate_s = "ok" if gates and all(gates.values()) else ",".join(
+                k for k, v in gates.items() if not v
+            ) or "—"
+            print(
+                f"| {name} | {r.get('shards', '—')} "
+                f"| {r.get('kills_injected', '—')} "
+                f"| {r.get('epochs_submitted', '—')} "
+                f"| {out.get('done_clean', '—')} "
+                f"| {out.get('recovered', '—')} "
+                f"| {out.get('aborted_transient', 0)} "
+                f"| {r.get('lost_broadcast_sessions', '—')} "
+                f"| {r.get('wrong_verdicts', '—')} "
+                f"| {r.get('wedged', '—')} "
+                f"| {mttr.get('mean', '—')}/{mttr.get('max', '—')}s "
+                f"| {r.get('bystander_p99_s', '—')}s "
+                f"| {gate_s} |"
+            )
+        print()
+        for name, r in storms:
+            fos = r.get("failovers") or []
+            if not fos:
+                continue
+            print(f"#### failover / journal-replay detail: {name}\n")
+            print("| failover | dead -> peer | committees moved "
+                  "| replayed terminal | resumed | transient "
+                  "| torn tails | MTTR |")
+            print("|---|---|---|---|---|---|---|---|")
+            for fo in fos:
+                rec2 = fo.get("recovery") or {}
+                print(
+                    f"| gen {fo.get('gen')} "
+                    f"| {fo.get('dead')} -> {fo.get('peer')} "
+                    f"| {fo.get('committees', '—')} "
+                    f"| {rec2.get('replayed_terminal', '—')} "
+                    f"| {rec2.get('resumed', '—')} "
+                    f"| {rec2.get('aborted_transient', '—')} "
+                    f"| {rec2.get('torn_tails', '—')} "
+                    f"| {fo.get('mttr_s', '—')}s |"
+                )
+            print()
+            jagg = (r.get("aggregate") or {}).get("journal") or {}
+            if jagg:
+                print(
+                    f"journal aggregate: {int(jagg.get('records', 0))} "
+                    f"records, {int(jagg.get('bytes', 0))} bytes, "
+                    f"{int(jagg.get('segments', 0))} segments, "
+                    f"{int(jagg.get('fsyncs', 0))} fsyncs\n"
+                )
 
     if kernels:
         print("### kernel sweep (modexp rows/s, real chip)\n")

@@ -124,6 +124,23 @@ def parse_args():
     p.add_argument("--p99-bound", type=float, default=3.0,
                    help="chaos gate: healthy-traffic p99 must stay within "
                         "this factor of the fault-free baseline")
+    # ---- crash-storm mode (ISSUE 12) ---------------------------------
+    p.add_argument("--crash-storm", action="store_true",
+                   help="Poisson window over a multi-process shard "
+                        "supervisor with periodic SIGKILLs; emits "
+                        "bench_results/crash_storm.json")
+    p.add_argument("--shards", type=int, default=4,
+                   help="shard processes under the supervisor")
+    p.add_argument("--kills", type=int, default=3,
+                   help="shard SIGKILLs injected across the window "
+                        "(the shard_kill fault site)")
+    p.add_argument("--journal-root", default=None,
+                   help="journal root directory (default: a temp dir; "
+                        "journals hold PUBLIC data only)")
+    p.add_argument("--journal-dir", default=None,
+                   help="journal THIS run's single service to the given "
+                        "directory (durability A/B for sustained/chaos "
+                        "windows; the report gains a `journal` block)")
     return p.parse_args()
 
 
@@ -285,8 +302,255 @@ def run_tamper_curve(svc, cids, rates, sessions_per_rate, seed, drain_timeout,
     return curve
 
 
+def run_crash_storm(args):
+    """ISSUE 12 acceptance harness: Poisson refresh arrivals over a
+    multi-process ShardSupervisor while the `shard_kill` fault site
+    SIGKILLs shards mid-window. Every submitted epoch is classified
+    (done_clean / recovered after failover-replay-resubmit /
+    aborted_transient / rejected / LOST), and the report gates on zero
+    lost accepted broadcasts, zero wrong verdicts, zero wedged
+    sessions, with MTTR per failover and the healthy-bystander p99
+    (committees whose shard never died)."""
+    import tempfile
+
+    from fsdkr_tpu.config import ProtocolConfig
+    from fsdkr_tpu.protocol import simulate_keygen
+    from fsdkr_tpu.serving import faults, recovery
+    from fsdkr_tpu.serving.supervisor import ShardSupervisor
+    from fsdkr_tpu.telemetry import export as tel_export
+
+    log = lambda *a: print(*a, file=sys.stderr, flush=True)
+    t_start = time.time()
+    config = ProtocolConfig(
+        paillier_bits=args.bits,
+        m_security=args.m_security,
+        correct_key_rounds=args.ck_rounds,
+        backend=args.backend,
+    )
+    rng = random.Random(args.seed)
+    rate = args.rate or 1.0
+    deadline_s = args.deadline or 8.0
+    root = args.journal_root or tempfile.mkdtemp(prefix="fsdkr_storm_")
+
+    # the kill schedule is seed-deterministic through the fault plan:
+    # evenly spaced ticks across the window, each consulted against the
+    # shard_kill site (rate 1.0, capped at --kills)
+    plan = faults.configure(
+        f"seed={args.seed},shard_kill=1.0,shard_kill_max={args.kills}"
+    )
+
+    log(f"[storm] keygen {args.bases} base committees "
+        f"(n={args.n}, t={args.t}, {args.bits}-bit)")
+    t0 = time.time()
+    keygen = getattr(simulate_keygen, "uncached", simulate_keygen)
+    bases = [keygen(args.t, args.n, config) for _ in range(args.bases)]
+    committees = {
+        cid: [k.clone() for k in bases[cid % args.bases]]
+        for cid in range(args.committees)
+    }
+    keygen_s = time.time() - t0
+
+    sup = ShardSupervisor(
+        shards=args.shards,
+        root=root,
+        deadline_s=deadline_s,
+        retries=args.retries if args.retries is not None else 2,
+        hb_interval=0.3,
+    )
+    t0 = time.time()
+    sup.start()
+    log(f"[storm] {args.shards} shards ready in {time.time() - t0:.1f}s "
+        f"(journals under {root})")
+    for cid, keys in committees.items():
+        sup.admit(cid, keys, config)
+
+    # seed epoch 0 everywhere (unmeasured; warms shard engine caches)
+    t0 = time.time()
+    epoch_of = {}
+    for cid in committees:
+        sup.submit(cid, 0)
+        epoch_of[cid] = 1
+    if not sup.drain(timeout=max(args.drain_timeout, 10 * args.committees)):
+        log(f"[storm] WARNING: seed epoch did not drain: {sup.pending}")
+    seed_s = time.time() - t0
+    seed_outcomes = list(sup.outcomes)
+    sup.outcomes.clear()
+    log(f"[storm] seeded {len(seed_outcomes)} epochs in {seed_s:.1f}s")
+
+    # ---- measured window: Poisson arrivals + the kill schedule -------
+    kill_ticks = [
+        (i + 1) * args.window / (args.kills + 1) for i in range(args.kills)
+    ]
+    kills_done, killed_shards = 0, []
+    t_win = time.monotonic()
+    next_arrival = t_win
+    while True:
+        now = time.monotonic()
+        if now - t_win >= args.window:
+            break
+        while kill_ticks and now - t_win >= kill_ticks[0]:
+            tick = kill_ticks.pop(0)
+            if plan.fire("shard_kill", (round(tick, 3),)):
+                # prefer a victim with sessions IN FLIGHT (mid-window
+                # kill is the point), then any committee owner;
+                # kill_shard refuses to take the last shard
+                alive = [h for h in sup.shards if h.alive]
+                busy_idx = {p["shard"] for p in sup.pending.values()}
+                busy = [h for h in alive if h.idx in busy_idx]
+                owners = [h for h in alive if h.committees]
+                victim = rng.choice(busy or owners or alive)
+                k = sup.kill_shard(victim.idx)
+                if k is not None:
+                    kills_done += 1
+                    killed_shards.append(k)
+                    log(f"[storm] t+{now - t_win:.1f}s SIGKILL shard {k}")
+        if now >= next_arrival:
+            next_arrival += rng.expovariate(rate)
+            cid = rng.choice(list(committees))
+            sup.submit(cid, epoch_of[cid])
+            epoch_of[cid] += 1
+        sup.pump(0.02)
+    window_wall = time.monotonic() - t_win
+    drained = sup.drain(timeout=args.drain_timeout)
+    drain_wall = time.monotonic() - t_win - window_wall
+    faults.reset()
+
+    # ---- classification ----------------------------------------------
+    outcomes = list(sup.outcomes)
+    agg = sup.aggregate()
+    failovers = agg["failovers"]
+    moved_cids = {c for fo in failovers for c in fo.get("moved", [])}
+    cls = {"done_clean": 0, "recovered": 0, "aborted_transient": 0,
+           "timed_out": 0, "rejected": 0, "aborted_blame": 0}
+    wrong = []
+    for o in outcomes:
+        if o["state"] == "done":
+            cls["recovered" if (o["via"] != "primary" or o["resubmits"])
+                else "done_clean"] += 1
+        elif o["state"] == "rejected":
+            cls["rejected"] += 1
+        elif o["state"] == "timed_out":
+            cls["timed_out"] += 1
+        elif o["blame"]:
+            # no tampering is injected in the storm: any blame verdict
+            # is a wrong verdict by construction
+            cls["aborted_blame"] += 1
+            wrong.append(f"{o['cid']}/{o['epoch']}: blamed: {o['error']}")
+        else:
+            cls["aborted_transient"] += 1
+    wedged = len(sup.pending)
+
+    # ---- zero-lost-broadcast audit across every journal --------------
+    # every session that ever ACCEPTED a broadcast must be accounted:
+    # a terminal record in its own journal, or its journal was adopted
+    # by a recovery (whose report settles every non-terminal session)
+    recovered_dirs = {fo["journal_dir"] for fo in failovers
+                      if fo.get("recovery")}
+    lost_sessions = []
+    scanned = {"journals": 0, "sessions": 0, "broadcast_records": 0,
+               "terminal_records": 0}
+    for shard_dir in sorted(pathlib.Path(root).glob("shard*")):
+        sessions, _coms = recovery.load_state(shard_dir)
+        scanned["journals"] += 1
+        scanned["sessions"] += len(sessions)
+        for sid, js in sessions.items():
+            scanned["broadcast_records"] += len(js.broadcasts)
+            scanned["terminal_records"] += js.terminal is not None
+            if js.broadcasts and js.terminal is None \
+                    and str(shard_dir) not in recovered_dirs:
+                lost_sessions.append(f"{shard_dir.name}:{sid}")
+    mttrs = [fo["mttr_s"] for fo in failovers if fo.get("mttr_s")]
+    recovers = [fo["recover_s"] for fo in failovers if fo.get("recover_s")]
+    bystander_lat = sorted(
+        o["latency_s"] for o in outcomes
+        if o["state"] == "done" and o["via"] == "primary"
+        and o["cid"] not in moved_cids and o["latency_s"] is not None
+    )
+
+    report = {
+        "metric": "serve_crash_storm",
+        "platform": "host-shards",
+        "committees": args.committees,
+        "distinct_bases": args.bases,
+        "n": args.n,
+        "t": args.t,
+        "paillier_bits": args.bits,
+        "m_security": args.m_security,
+        "shards": args.shards,
+        "window_s": round(window_wall, 2),
+        "drain_s": round(drain_wall, 2),
+        "drained": drained,
+        "offered_rate_hz": rate,
+        "deadline_s": deadline_s,
+        "seed": args.seed,
+        "fault_spec": plan.spec(),
+        "kills_injected": kills_done,
+        "killed_shards": killed_shards,
+        "epochs_submitted": len(outcomes) + wedged,
+        "outcomes": cls,
+        "wrong_verdicts": len(wrong),
+        "wrong_detail": wrong[:8],
+        "wedged": wedged,
+        "wedged_detail": [f"{c}/{e}" for (c, e) in list(sup.pending)[:8]],
+        "lost_broadcast_sessions": len(lost_sessions),
+        "lost_detail": lost_sessions[:8],
+        "journal_audit": scanned,
+        "mttr_s": {
+            "per_failover": mttrs,
+            "mean": round(sum(mttrs) / len(mttrs), 3) if mttrs else None,
+            "max": round(max(mttrs), 3) if mttrs else None,
+        },
+        # death detection -> journal replay adopted on the peer (the
+        # floor every failover pays, measured even when no epoch was
+        # interrupted; MTTR above additionally includes the first
+        # interrupted epoch completing)
+        "recover_s": {
+            "per_failover": recovers,
+            "mean": (
+                round(sum(recovers) / len(recovers), 3) if recovers else None
+            ),
+            "max": round(max(recovers), 3) if recovers else None,
+        },
+        "bystander_p99_s": percentile(bystander_lat, 0.99),
+        "bystander_done": len(bystander_lat),
+        "failovers": failovers,
+        "aggregate": {k: agg[k] for k in ("serving", "journal", "alive")},
+        "setup": {
+            "keygen_s": round(keygen_s, 1),
+            "seed_s": round(seed_s, 1),
+            "seed_epochs_done": sum(
+                o["state"] == "done" for o in seed_outcomes
+            ),
+        },
+        "gates": {
+            "zero_lost_broadcasts": len(lost_sessions) == 0,
+            "zero_wrong_verdicts": len(wrong) == 0,
+            "zero_wedged": wedged == 0,
+            # the ISSUE 12 acceptance storm wants >= 3; a smaller
+            # --kills run gates against its own configuration
+            "kills_injected": kills_done >= min(3, args.kills),
+        },
+    }
+    report["telemetry"] = tel_export.snapshot()
+    sup.stop()
+
+    out = args.out or "bench_results/crash_storm.json"
+    pathlib.Path(out).parent.mkdir(parents=True, exist_ok=True)
+    pathlib.Path(out).write_text(json.dumps(report, indent=1, default=str)
+                                 + "\n")
+    log(f"[storm] {kills_done} kills, outcomes {cls}, "
+        f"MTTR mean {report['mttr_s']['mean']}s, "
+        f"bystander p99 {report['bystander_p99_s']}s, "
+        f"lost {len(lost_sessions)}, wrong {len(wrong)}, wedged {wedged}")
+    log(f"[storm] report -> {out} (total wall {time.time() - t_start:.0f}s)")
+    print(json.dumps(report, default=str))
+    return 0 if all(report["gates"].values()) else 1
+
+
 def main():
     args = parse_args()
+    if args.crash_storm:
+        return run_crash_storm(args)
     t_start = time.time()
     tag = args.tag or ("storm" if args.chaos else "sustained")
 
@@ -341,10 +605,12 @@ def main():
             overload=OverloadPolicy(max_queue=args.max_backlog,
                                     shed_p99_factor=0.0),
             guard=BisectGuard(budget=args.bisect_budget),
+            journal=args.journal_dir,
         )
     else:
         svc = RefreshService(
-            deadline_s=deadline_s or None, retries=args.retries
+            deadline_s=deadline_s or None, retries=args.retries,
+            journal=args.journal_dir,
         )
     # per-committee rate: the offered total spread uniformly
     per_rate = (args.rate or 1.0) / max(1, args.committees)
@@ -514,6 +780,9 @@ def main():
         # the memory-plan block — the serving loop's bounded-per-session
         # claim is checkable from the report alone
         "mem": _mem_block(),
+        # durability accounting (ISSUE 12): present when --journal-dir
+        # put a write-ahead log under this run
+        "journal": svc.journal_stats(),
         "setup": {
             "keygen_s": round(keygen_s, 1),
             "seed_epochs": args.seed_epochs,

@@ -1,0 +1,201 @@
+"""The public-broadcast journal (ISSUE 12): CRC framing, segment
+rotation, fsync policy, torn-tail tolerance vs mid-segment corruption,
+and the journal_torn_write chaos site. Recovery semantics (what the
+records MEAN) live in tests/test_recovery.py; here the FILE FORMAT is
+the contract — a peer shard must be able to replay a journal it did
+not write."""
+
+import os
+
+import pytest
+
+from fsdkr_tpu.serving import faults
+from fsdkr_tpu.serving.journal import (
+    Journal,
+    JournalCorruption,
+    read_records,
+    SEGMENT_MAGIC,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _recs(n, start=0):
+    return [{"t": "broadcast", "sid": 1, "sender": start + i,
+             "wire": "ab" * 50} for i in range(n)]
+
+
+def test_append_read_roundtrip_in_order(tmp_path):
+    j = Journal(tmp_path / "j", sync="off")
+    recs = _recs(10)
+    for r in recs:
+        j.append(r)
+    j.close()
+    assert read_records(tmp_path / "j") == recs
+    st = j.stats()
+    assert st["records"] == 10 and st["segments"] == 1
+    assert st["bytes"] > 0
+
+
+def test_segment_rotation_and_fresh_segment_on_reopen(tmp_path):
+    # tiny segments force rotation; order must survive the segment cuts
+    j = Journal(tmp_path / "j", sync="off", segment_bytes=4096)
+    recs = _recs(40)
+    for r in recs:
+        j.append(r)
+    j.close()
+    segs = Journal.segment_paths(tmp_path / "j")
+    assert len(segs) > 1
+    assert all(s.read_bytes().startswith(SEGMENT_MAGIC) for s in segs)
+    assert read_records(tmp_path / "j") == recs
+    # a NEW journal over the same directory never appends to an old
+    # segment (a predecessor's tail may be torn): fresh file, higher idx
+    j2 = Journal(tmp_path / "j", sync="off")
+    j2.append({"t": "x", "sid": 2})
+    j2.close()
+    segs2 = Journal.segment_paths(tmp_path / "j")
+    assert len(segs2) == len(segs) + 1
+    assert read_records(tmp_path / "j") == recs + [{"t": "x", "sid": 2}]
+
+
+def test_sync_policies(tmp_path, monkeypatch):
+    ja = Journal(tmp_path / "a", sync="always")
+    for r in _recs(3):
+        ja.append(r)
+    assert ja.fsyncs == 3
+    ja.close()
+    jb = Journal(tmp_path / "b", sync="batch", batch_records=2)
+    for r in _recs(3):
+        jb.append(r)
+    assert jb.fsyncs == 1  # one full batch; the tail syncs at close
+    jb.close()
+    assert jb.fsyncs == 2
+    jo = Journal(tmp_path / "c", sync="off")
+    for r in _recs(3):
+        jo.append(r)
+    jo.close()
+    assert jo.fsyncs == 0
+    # the env knob parses strictly: a typo must not silently mean "off"
+    monkeypatch.setenv("FSDKR_JOURNAL_SYNC", "fsync-plz")
+    with pytest.raises(ValueError, match="FSDKR_JOURNAL_SYNC"):
+        Journal(tmp_path / "d")
+    monkeypatch.setenv("FSDKR_JOURNAL_SYNC", "always")
+    assert Journal(tmp_path / "e").sync_policy == "always"
+
+
+def test_torn_tail_dropped_and_counted(tmp_path):
+    from fsdkr_tpu.telemetry import registry
+
+    j = Journal(tmp_path / "j", sync="off")
+    recs = _recs(5)
+    for r in recs:
+        j.append(r)
+    j.close()
+    seg = Journal.segment_paths(tmp_path / "j")[0]
+    data = seg.read_bytes()
+    torn = registry.counter("fsdkr_journal_torn_tails")
+    # truncate INSIDE the final record's payload: the crash-mid-write
+    # shape — dropped, counted, everything before it survives
+    t0 = torn.value()
+    seg.write_bytes(data[:-20])
+    assert read_records(tmp_path / "j") == recs[:-1]
+    assert torn.value() == t0 + 1
+    # truncate inside the final record's frame HEADER: same treatment
+    import json as _json
+    import struct
+
+    payload = _json.dumps(recs[-1], sort_keys=True,
+                          separators=(",", ":")).encode()
+    frame_len = struct.calcsize("<II") + len(payload)
+    seg.write_bytes(data[: len(data) - frame_len + 3])
+    t1 = torn.value()
+    assert read_records(tmp_path / "j") == recs[:-1]
+    assert torn.value() == t1 + 1
+
+
+def test_mid_segment_corruption_raises_naming_segment_and_offset(tmp_path):
+    j = Journal(tmp_path / "j", sync="off")
+    for r in _recs(5):
+        j.append(r)
+    j.close()
+    seg = Journal.segment_paths(tmp_path / "j")[0]
+    data = bytearray(seg.read_bytes())
+    # flip one payload byte in the MIDDLE of the file: CRC mismatch is
+    # real corruption, never silently skipped
+    mid = len(data) // 2
+    data[mid] ^= 0xFF
+    seg.write_bytes(bytes(data))
+    with pytest.raises(JournalCorruption) as ei:
+        read_records(tmp_path / "j")
+    assert seg.name in str(ei.value)
+    assert "offset" in str(ei.value)
+    assert ei.value.offset > 0
+
+
+def test_bad_magic_raises(tmp_path):
+    j = Journal(tmp_path / "j", sync="off")
+    j.append({"t": "x"})
+    j.close()
+    seg = Journal.segment_paths(tmp_path / "j")[0]
+    seg.write_bytes(b"NOTAWAL!" + seg.read_bytes()[8:])
+    with pytest.raises(JournalCorruption, match="magic"):
+        read_records(tmp_path / "j")
+
+
+def test_missing_and_empty_directory_are_clean_noops(tmp_path):
+    assert read_records(tmp_path / "nonexistent") == []
+    (tmp_path / "empty").mkdir()
+    assert read_records(tmp_path / "empty") == []
+
+
+def test_torn_write_fault_site(tmp_path):
+    """journal_torn_write truncates the active segment mid-record: the
+    record is LOST (that is the simulated crash), replay drops the torn
+    tail of that segment and keeps everything else, and later appends
+    land in a fresh segment."""
+    from fsdkr_tpu.telemetry import registry
+
+    j = Journal(tmp_path / "j", sync="off")
+    j.append({"t": "a"})
+    faults.configure("seed=5,journal_torn_write=1.0,journal_torn_write_max=1")
+    j.append({"t": "b"})  # torn: lost on disk
+    faults.reset()
+    j.append({"t": "c"})
+    j.close()
+    assert len(Journal.segment_paths(tmp_path / "j")) == 2
+    t0 = registry.counter("fsdkr_journal_torn_tails").value()
+    assert read_records(tmp_path / "j") == [{"t": "a"}, {"t": "c"}]
+    assert registry.counter("fsdkr_journal_torn_tails").value() == t0 + 1
+    assert registry.counter(
+        "fsdkr_fault_injected", labelnames=("site",)
+    ).value(site="journal_torn_write") >= 1
+
+
+def test_registry_counters_track_appends(tmp_path):
+    from fsdkr_tpu.telemetry import registry
+
+    r0 = registry.counter("fsdkr_journal_records").value()
+    b0 = registry.counter("fsdkr_journal_bytes").value()
+    s0 = registry.counter("fsdkr_journal_segments").value()
+    j = Journal(tmp_path / "j", sync="off")
+    for r in _recs(4):
+        j.append(r)
+    j.close()
+    assert registry.counter("fsdkr_journal_records").value() == r0 + 4
+    assert registry.counter("fsdkr_journal_bytes").value() == b0 + j.bytes
+    assert registry.counter("fsdkr_journal_segments").value() == s0 + 1
+
+
+def test_closed_journal_refuses_appends(tmp_path):
+    j = Journal(tmp_path / "j", sync="off")
+    j.append({"t": "a"})
+    j.close()
+    j.close()  # idempotent
+    with pytest.raises(RuntimeError, match="closed"):
+        j.append({"t": "b"})
+    assert os.path.isdir(tmp_path / "j")

@@ -1,0 +1,378 @@
+"""Crash recovery (ISSUE 12): journal replay through the shared
+offer()/finalize path.
+
+The contract under test: a session interrupted by process death
+replays from the public-broadcast journal to the SAME verdict,
+identifiable-abort blame, and adopted LocalKey state as the
+uninterrupted run (shared-helper equivalence, like every prior
+streaming/barrier pin) — honest and tampered, at n=3 (full service
+path) and n=16 (a single-receiver shard replaying a foreign journal).
+Terminal records replay their stored verdict with no recompute; a
+session whose secret state cannot be re-derived aborts WITHOUT blame
+(transient, retryable); an empty journal is a no-op; `submit(cid,
+epoch=N)` keeps deduping across the restart."""
+
+import time
+
+import pytest
+
+from fsdkr_tpu import precompute
+from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+from fsdkr_tpu.protocol.serialization import (
+    refresh_message_from_json,
+    refresh_message_to_json,
+)
+from fsdkr_tpu.serving import (
+    BatchPolicy,
+    Journal,
+    MemoryKeystore,
+    RefreshService,
+    faults,
+    recovery,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    precompute.clear_targets()
+    precompute.clear_pools()
+    yield
+    faults.reset()
+    precompute.clear_targets()
+    precompute.clear_pools()
+
+
+def _err_key(e):
+    return (type(e).__name__, tuple(map(str, getattr(e, "args", ()))))
+
+
+def _assert_keys_equal(a, b):
+    assert a.keys_linear.x_i.to_int() == b.keys_linear.x_i.to_int()
+    assert a.pk_vec == b.pk_vec
+    assert [ek.n for ek in a.paillier_key_vec] == [
+        ek.n for ek in b.paillier_key_vec
+    ]
+    assert a.paillier_dk.p == b.paillier_dk.p
+
+
+def _crash_mid_flight(jdir, keys, config, spec=None):
+    """Run one journaled service session to quorum with the launcher
+    lingering 'forever', then crash: abandon the service object. What
+    survives is exactly what survives real process death — the journal
+    on disk — plus the keystore, which stands in for the re-derivable
+    secret state (in-process restart semantics)."""
+    ks = MemoryKeystore()
+    svc = RefreshService(
+        journal=str(jdir),
+        keystore=ks,
+        policy=BatchPolicy(max_sessions=10 ** 6, linger_s=3600.0),
+    )
+    svc.admit("com", [k.clone() for k in keys], config)
+    svc.start()
+    if spec:
+        faults.configure(spec)
+    sid = svc.submit("com", epoch=0)
+    deadline = time.monotonic() + 120
+    ready = False
+    while time.monotonic() < deadline:
+        with svc._lock:
+            if svc._ready:
+                ready = True
+                break
+        time.sleep(0.02)
+    faults.reset()
+    svc.stop(timeout=10)
+    assert ready, "session never reached quorum before the crash"
+    return ks, sid
+
+
+def _control_barrier(jdir, ks, sid, config, cid="com"):
+    """The uninterrupted run: barrier collect over the journaled wire
+    messages (canonical order) on CLONES of the keystore's key state.
+    Returns (per-party error keys, control key clones)."""
+    js = recovery.load_state(jdir)[0][sid]
+    msgs = sorted(
+        (refresh_message_from_json(w) for _s, w in js.broadcasts),
+        key=lambda m: m.party_index,
+    )
+    dks = ks.session_dks(cid, sid)
+    control = [k.clone() for k in ks.committee_keys(cid)]
+    errs = []
+    for i, k in enumerate(control):
+        try:
+            RefreshMessage.collect(msgs, k, dks[i], (), config)
+            errs.append(None)
+        except Exception as e:
+            errs.append(_err_key(e))
+    return errs, control
+
+
+def test_resume_bit_identity_honest_n3(tmp_path, test_config):
+    """A session killed between quorum and finalize resumes from the
+    journal and adopts the EXACT key state the uninterrupted barrier
+    run produces."""
+    keys = simulate_keygen(1, 3, test_config)
+    jdir = tmp_path / "j"
+    ks, sid = _crash_mid_flight(jdir, keys, test_config)
+    control_errs, control = _control_barrier(jdir, ks, sid, test_config)
+    assert control_errs == [None, None, None]
+
+    svc2 = RefreshService(journal=str(jdir), keystore=ks)
+    svc2.start()
+    try:
+        rep = recovery.recover(svc2, jdir, ks)
+        assert rep["resumed"] == 1 and rep["replayed_terminal"] == 0
+        assert rep["committees_admitted"] == 1
+        assert rep["broadcasts_replayed"] == 3
+        new_sid = rep["sessions"][sid]["sid"]
+        assert svc2.drain(timeout=60)
+        s2 = svc2.wait(new_sid, timeout=1)
+        assert s2.state == "done" and s2.error is None and not s2.blame
+        for a, b in zip(control, ks.committee_keys("com")):
+            _assert_keys_equal(a, b)
+    finally:
+        svc2.stop()
+
+    # double-recovery chain regression: a THIRD incarnation of the same
+    # directory must NOT re-resume the original session (it was
+    # superseded) — re-running the old broadcasts against the rotated
+    # keys would re-adopt or blame honest senders. The origin's dks
+    # are gone from the keystore, nothing resumes, and the committee
+    # key state is untouched.
+    assert ks.session_dks("com", sid) is None
+    x_after = [k.keys_linear.x_i.to_int() for k in ks.committee_keys("com")]
+    svc3 = RefreshService(journal=str(jdir), keystore=ks)
+    svc3.start()
+    try:
+        rep3 = recovery.recover(svc3, jdir, ks)
+        assert rep3["resumed"] == 0 and rep3["aborted_transient"] == 0
+        # origin sid replays as a superseded terminal; the resumed
+        # session replays its done verdict — nothing recomputes
+        assert rep3["sessions"][sid]["disposition"] == "replayed_terminal"
+        assert rep3["sessions"][new_sid]["state"] == "done"
+        assert [
+            k.keys_linear.x_i.to_int() for k in ks.committee_keys("com")
+        ] == x_after
+    finally:
+        svc3.stop()
+
+
+def test_resume_bit_identity_tampered_n3(tmp_path, test_config):
+    """The journaled copy of a tampered broadcast (first arrival wins)
+    replays to the SAME identifiable-abort blame the uninterrupted run
+    produces — and no adoption happens on either side."""
+    keys = simulate_keygen(1, 3, test_config)
+    jdir = tmp_path / "j"
+    ks, sid = _crash_mid_flight(
+        jdir, keys, test_config, spec="seed=21,msg_tamper=1.0,msg_tamper_max=1"
+    )
+    control_errs, control = _control_barrier(jdir, ks, sid, test_config)
+    assert any(e is not None for e in control_errs)
+    blame_type = next(e for e in control_errs if e is not None)[0]
+
+    svc2 = RefreshService(journal=str(jdir), keystore=ks)
+    svc2.start()
+    try:
+        rep = recovery.recover(svc2, jdir, ks)
+        new_sid = rep["sessions"][sid]["sid"]
+        assert svc2.drain(timeout=60)
+        s2 = svc2.wait(new_sid, timeout=1)
+        assert s2.state == "aborted" and s2.blame, (s2.state, s2.error)
+        assert blame_type in s2.error
+        # a blamed session never adopted: key state matches the control
+        # (whose collect also raised before adoption)
+        for a, b in zip(control, ks.committee_keys("com")):
+            _assert_keys_equal(a, b)
+    finally:
+        svc2.stop()
+
+
+def test_terminal_replay_and_restart_idempotency(tmp_path, test_config):
+    """ISSUE 12 satellite: a done epoch's terminal record replays its
+    verdict with NO recompute, and `submit(cid, epoch=N)` keeps
+    deduping from the journaled history after the restart (pinned
+    restart-then-resubmit)."""
+    keys = simulate_keygen(1, 3, test_config)
+    jdir = tmp_path / "j"
+    ks = MemoryKeystore()
+    svc = RefreshService(journal=str(jdir), keystore=ks)
+    svc.admit("com", [k.clone() for k in keys], test_config)
+    svc.start()
+    sid = svc.submit("com", epoch=0)
+    assert svc.drain(timeout=60)
+    assert svc.wait(sid, timeout=1).state == "done"
+    svc.stop()
+
+    svc2 = RefreshService(journal=str(jdir), keystore=ks)
+    svc2.start()
+    try:
+        rep = recovery.recover(svc2, jdir, ks)
+        assert rep["replayed_terminal"] == 1 and rep["resumed"] == 0
+        new_sid = rep["sessions"][sid]["sid"]
+        s2 = svc2.wait(new_sid, timeout=1)
+        assert s2.state == "done"
+        assert svc2.stats()["sessions_replayed"] == 1
+        assert svc2.stats()["sessions_done"] == 0  # verdict, not work
+        # the restart-then-resubmit pin: epoch 0 dedupes to the
+        # replayed verdict; epoch 1 actually runs
+        assert svc2.submit("com", epoch=0) == new_sid
+        assert svc2.stats()["sessions_done"] == 0
+        sid1 = svc2.submit("com", epoch=1)
+        assert sid1 != new_sid
+        assert svc2.drain(timeout=60)
+        assert svc2.wait(sid1, timeout=1).state == "done"
+        assert svc2.stats()["sessions_done"] == 1
+    finally:
+        svc2.stop()
+    # same-directory restarts must not double the terminal set: the
+    # replayed verdict is NOT re-journaled into the log it came from
+    # (a peer adopting a foreign journal does re-journal). Epoch 0 has
+    # exactly one terminal record however many times we restart.
+    from fsdkr_tpu.serving.journal import read_records
+
+    terminals_e0 = [
+        r for r in read_records(jdir)
+        if r.get("t") == "terminal" and r.get("epoch") == 0
+    ]
+    assert len(terminals_e0) == 1, terminals_e0
+
+
+def test_unrecoverable_secrets_abort_transient_retryable(
+    tmp_path, test_config
+):
+    """Cross-process death: the session's new dks died with the shard.
+    Recovery must terminate the session `aborted` WITHOUT blame
+    (RecoverySecretsUnavailable is not a verdict) and leave the epoch
+    resubmittable — never fabricate a verdict."""
+    keys = simulate_keygen(1, 3, test_config)
+    jdir = tmp_path / "j"
+    ks, sid = _crash_mid_flight(jdir, keys, test_config)
+    # a peer shard's keystore: committee keys re-derivable, session
+    # secrets NOT (they lived only in the dead process)
+    ks2 = MemoryKeystore()
+    ks2.put_committee("com", ks.committee_keys("com"))
+    svc2 = RefreshService(journal=str(jdir), keystore=ks2)
+    svc2.start()
+    try:
+        rep = recovery.recover(svc2, jdir, ks2)
+        assert rep["aborted_transient"] == 1 and rep["resumed"] == 0
+        new_sid = rep["sessions"][sid]["sid"]
+        s2 = svc2.wait(new_sid, timeout=1)
+        assert s2.state == "aborted" and not s2.blame
+        assert "RecoverySecretsUnavailable" in s2.error
+        # retryable: the same epoch resubmits as a FRESH session and
+        # completes (the supervisor's failover path)
+        sid2 = svc2.submit("com", epoch=0)
+        assert sid2 != new_sid
+        assert svc2.drain(timeout=60)
+        assert svc2.wait(sid2, timeout=1).state == "done"
+    finally:
+        svc2.stop()
+
+
+def test_recover_missing_or_empty_journal_is_noop(tmp_path, test_config):
+    keys = simulate_keygen(1, 3, test_config)
+    svc = RefreshService(journal=str(tmp_path / "live"))
+    svc.admit("com", [k.clone() for k in keys], test_config)
+    rep = recovery.recover(svc, tmp_path / "nonexistent")
+    assert rep["resumed"] == rep["replayed_terminal"] == 0
+    assert rep["aborted_transient"] == rep["skipped"] == 0
+    (tmp_path / "empty").mkdir()
+    rep = recovery.recover(svc, tmp_path / "empty")
+    assert rep["resumed"] == rep["replayed_terminal"] == 0
+    assert svc.stats()["inflight"] == 0
+
+
+def _n16_journal(j, sid, cid, wires, order, config):
+    """Hand-write one single-receiver session into a journal: the
+    deployment shape where a shard hosts ONE party of a large
+    committee, and recovery replays a journal its writer never shared
+    a process with (the file format is the contract)."""
+    j.append(
+        {
+            "t": "committee",
+            "cid": cid,
+            "n": 1,
+            "tt": 7,
+            "config": recovery.config_record(config),
+        }
+    )
+    j.append({"t": "admitted", "sid": sid, "cid": cid, "epoch": 0})
+    j.append(
+        {"t": "collecting", "sid": sid, "expected": list(range(1, 17))}
+    )
+    for i in order:
+        j.append(
+            {"t": "broadcast", "sid": sid, "sender": i + 1,
+             "wire": wires[i]}
+        )
+
+
+def test_n16_replay_bit_identity_honest_and_tampered(tmp_path, test_config):
+    """The n=16 pin (acceptance): replayed verdict + blame bit-identical
+    to the uninterrupted run, honest AND tampered, through a journal
+    the recovering shard did not write. One distribute feeds both arms;
+    the controls run as one fused barrier launch and the two resumed
+    sessions COALESCE into one fused finalize (the recovery launch
+    shape a real shard uses), so the pin also covers fused-launch
+    isolation after replay."""
+    keys = simulate_keygen(7, 16, test_config)
+    results = RefreshMessage.distribute_batch(
+        [(k.i, k) for k in keys], 16, test_config
+    )
+    dk0 = results[0][1]
+    msgs_h = [m for m, _ in results]
+    msgs_t = list(msgs_h)
+    msgs_t[4] = faults.tamper_message(msgs_t[4])
+    base = keys[0].clone()  # post-distribute, pre-collect receiver state
+    import random as _random
+
+    order = list(range(16))
+    _random.Random(16).shuffle(order)  # journal = arrival order
+    jdir = tmp_path / "j16"
+    j = Journal(jdir, sync="off")
+    _n16_journal(
+        j, 1, "c16h", [refresh_message_to_json(m) for m in msgs_h],
+        order, test_config,
+    )
+    _n16_journal(
+        j, 2, "c16t", [refresh_message_to_json(m) for m in msgs_t],
+        order, test_config,
+    )
+    j.close()
+
+    # the uninterrupted run: both sessions in ONE fused barrier launch
+    control_h, control_t = base.clone(), base.clone()
+    errs = RefreshMessage.collect_sessions(
+        [(msgs_h, control_h, dk0, ()), (msgs_t, control_t, dk0, ())],
+        test_config,
+    )
+    assert errs[0] is None and errs[1] is not None
+    blame_type = _err_key(errs[1])[0]
+
+    ks = MemoryKeystore()
+    live_h, live_t = base.clone(), base.clone()
+    ks.put_committee("c16h", [live_h])
+    ks.put_committee("c16t", [live_t])
+    ks.put_session_dks("c16h", 1, [dk0])
+    ks.put_session_dks("c16t", 2, [dk0])
+    svc = RefreshService(journal=str(tmp_path / "peer"), keystore=ks)
+    svc.start()
+    try:
+        rep = recovery.recover(svc, jdir, ks)
+        assert rep["resumed"] == 2 and rep["broadcasts_replayed"] == 32
+        sid_h = rep["sessions"][1]["sid"]
+        sid_t = rep["sessions"][2]["sid"]
+        assert svc.drain(timeout=300)
+        s_h = svc.wait(sid_h, timeout=1)
+        assert s_h.state == "done" and s_h.error is None, (
+            s_h.state, s_h.error,
+        )
+        s_t = svc.wait(sid_t, timeout=1)
+        assert s_t.state == "aborted" and s_t.blame, (s_t.state, s_t.error)
+        assert blame_type in s_t.error
+        _assert_keys_equal(control_h, live_h)  # adopted identically
+        _assert_keys_equal(control_t, live_t)  # neither side adopted
+    finally:
+        svc.stop()

@@ -566,6 +566,37 @@ def test_traced_transcript_dump_has_no_secret_bytes(test_config, tmp_path):
         precompute.clear_pools()
         precompute.clear_targets()
 
+    # ISSUE 12: the public-broadcast journal is a persisted artifact
+    # too — run one journaled serving session over the same committee
+    # and grep its segments alongside everything else. The post-adopt
+    # committee keys hold the session's NEW secrets (rotated dks and
+    # shares); plant those as well, so "secrets are never journaled"
+    # covers the session's own key material, not just the seed state.
+    from fsdkr_tpu.serving import RefreshService
+
+    jdir = tmp_path / "journal"
+    svc = RefreshService(journal=str(jdir))
+    served = [k.clone() for k in keys]
+    svc.admit("sec", served, test_config)
+    svc.start()
+    try:
+        sid = svc.submit("sec")
+        assert svc.drain(timeout=180)
+        assert svc.wait(sid, timeout=1).state == "done"
+    finally:
+        svc.stop()
+        precompute.clear_pools()
+        precompute.clear_targets()
+    for k in served:
+        secrets_planted += [
+            k.paillier_dk.p, k.paillier_dk.q, k.keys_linear.x_i.to_int()
+        ]
+    journal_blob = "".join(
+        p.read_bytes().decode("latin1")
+        for p in sorted(jdir.glob("wal-*.seg"))
+    )
+    assert journal_blob, "journal left no segments to audit"
+
     trace_path = tr.write_chrome_trace(str(tmp_path / "t.json"))
     flight_path = flight.dump(str(tmp_path / "f.json"), reason="test")
     blob = (
@@ -573,6 +604,7 @@ def test_traced_transcript_dump_has_no_secret_bytes(test_config, tmp_path):
         + json.dumps(export.snapshot())
         + export.prometheus_text()
         + open(flight_path).read()
+        + journal_blob
     )
     assert len(tr.spans()) > 10  # the dump really covered the pipeline
     for s in secrets_planted:
