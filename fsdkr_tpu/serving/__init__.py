@@ -14,9 +14,15 @@ each session synchronously through the unchanged single-shot barrier
 API (`distribute_batch` + `collect_sessions`).
 """
 
+from .ingress import IngressClient, IngressServer  # noqa: F401
 from .journal import Journal, JournalCorruption  # noqa: F401
 from .planner import SLO, CapacityPlanner, serve_owner  # noqa: F401
-from .policy import BatchPolicy, BisectGuard, OverloadPolicy  # noqa: F401
+from .policy import (  # noqa: F401
+    BatchPolicy,
+    BisectGuard,
+    OverloadPolicy,
+    PeerRateLimiter,
+)
 from .recovery import (  # noqa: F401
     MemoryKeystore,
     RecoverySecretsUnavailable,
@@ -38,6 +44,9 @@ __all__ = [
     "BatchPolicy",
     "OverloadPolicy",
     "BisectGuard",
+    "PeerRateLimiter",
+    "IngressClient",
+    "IngressServer",
     "RefreshService",
     "ServeSession",
     "ServeRejected",

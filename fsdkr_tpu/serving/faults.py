@@ -57,6 +57,24 @@ crash-storm load generator and the journal, not by in-process hooks):
   prefix land on disk, exactly the shape a crash mid-write leaves, so
   the torn-tail replay path is exercised end to end.
 
+Network sites (ISSUE 13; consulted by the asyncio ingress server,
+`serving.ingress` — they act on CONNECTIONS and wire frames, never on
+protocol state, so a network-chaos storm can only ever look like a
+lossy network, not like a misbehaving verifier):
+
+- ``conn_drop``      — abort the client's TCP connection right after a
+  request frame arrives, before any response (keyed per connection +
+  frame sequence; the client must reconnect and resubmit — the
+  idempotent epoch submit dedupes).
+- ``frame_truncate`` — write only a prefix of a response frame, then
+  abort the connection (the torn-frame shape a crashed peer leaves;
+  the client's CRC/length check must treat it as a dead connection).
+- ``net_delay``      — hold a response for ``delay_s`` before writing
+  it (keyed like conn_drop; exercises client timeouts and the
+  server-side inflight-byte backpressure).
+- ``net_dup``        — write the response frame twice (clients
+  correlate by request id and must drop the duplicate).
+
 ## Zero cost when disabled
 
 Without ``FSDKR_FAULTS`` (and without an explicit `configure()`),
@@ -106,6 +124,10 @@ SITES = (
     "mem_squeeze",
     "shard_kill",
     "journal_torn_write",
+    "conn_drop",
+    "frame_truncate",
+    "net_delay",
+    "net_dup",
 )
 
 _SCALARS = ("seed", "delay_s", "squeeze_factor")

@@ -32,6 +32,13 @@ fallbacks inside `FSDKR_SERVE_BISECT_WINDOW_S` seconds is shed at
 admission until the window rolls — 5% malicious traffic pays with its
 own committee's throughput instead of DoSing the shard's verify
 engines. Default OFF (budget 0).
+
+`PeerRateLimiter` — per-peer token bucket for the network ingress
+(ISSUE 13), charged like the BisectGuard: a peer sending faster than
+`FSDKR_INGRESS_PEER_RPS` requests/second (burst = 2x) gets its request
+shed with a retry-after hint, and a peer that keeps hammering past the
+shed threshold pays with its own connection — the other peers'
+connections never feel it. Default OFF (rps 0).
 """
 
 from __future__ import annotations
@@ -42,7 +49,7 @@ import time
 from collections import deque
 from typing import Dict, Optional
 
-__all__ = ["BatchPolicy", "OverloadPolicy", "BisectGuard"]
+__all__ = ["BatchPolicy", "OverloadPolicy", "BisectGuard", "PeerRateLimiter"]
 
 
 def _env_int(name: str, default: int) -> int:
@@ -215,3 +222,57 @@ class BisectGuard:
                 return None
             # retry once the oldest charge ages out of the window
             return max(0.1, self.window_s - (now - q[0][0]))
+
+
+class PeerRateLimiter:
+    """Token-bucket per peer (keyed by host address, never by anything
+    the peer sends inside a frame). `charge(peer)` returns:
+
+    - ``None`` — admit the request (a token was spent).
+    - a float — shed this request; retry after that many seconds.
+    - ``-1.0`` — the peer kept hammering past a whole burst of sheds:
+      close its connection (it pays with its own connection, like an
+      over-budget committee pays with its own throughput under the
+      BisectGuard).
+
+    rps 0 disables the limiter. The bucket holds at most ``burst``
+    (default 2x rps) tokens, so a quiet peer can absorb a small spike;
+    debt beyond another burst of rejected requests is the
+    close-the-connection threshold. State is O(active peers) and
+    dropped via `forget()` when a peer's last connection closes."""
+
+    def __init__(self, rps: Optional[float] = None, burst: Optional[float] = None):
+        self.rps = (
+            rps if rps is not None else _env_float("FSDKR_INGRESS_PEER_RPS", 0.0)
+        )
+        self.burst = burst if burst is not None else max(1.0, 2.0 * self.rps)
+        self._lock = threading.Lock()
+        # peer -> [tokens, last_refill_monotonic, consecutive_sheds]
+        self._buckets: Dict[object, list] = {}
+
+    def enabled(self) -> bool:
+        return self.rps > 0
+
+    def charge(self, peer, now: Optional[float] = None) -> Optional[float]:
+        if not self.enabled():
+            return None
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            b = self._buckets.get(peer)
+            if b is None:
+                b = self._buckets[peer] = [self.burst, now, 0]
+            tokens = min(self.burst, b[0] + (now - b[1]) * self.rps)
+            b[1] = now
+            if tokens >= 1.0:
+                b[0] = tokens - 1.0
+                b[2] = 0
+                return None
+            b[0] = tokens
+            b[2] += 1
+            if b[2] > self.burst:
+                return -1.0
+            return max(0.05, (1.0 - tokens) / self.rps)
+
+    def forget(self, peer) -> None:
+        with self._lock:
+            self._buckets.pop(peer, None)

@@ -197,6 +197,16 @@ class ServeSession:
     blame: bool = False
     error: Optional[str] = None
     faults: List[str] = field(default_factory=list)
+    # network-fed session (ISSUE 13): the worker runs distribute and
+    # parks the wire-serialized broadcasts in `_wire_msgs` instead of
+    # self-feeding the collectors; the ingress hands them to the client
+    # (the broadcast channel) and routes the returned broadcasts back
+    # through `offer_external`. Broadcasts are public by definition —
+    # `_wire_msgs` holds exactly what any party would see on the wire.
+    external: bool = False
+    _wire_msgs: List[Tuple[int, str]] = field(
+        default_factory=list, repr=False
+    )
     _not_before: float = 0.0
     _pending: List[Tuple[float, object]] = field(
         default_factory=list, repr=False
@@ -204,6 +214,11 @@ class ServeSession:
     _streams: list = field(default_factory=list, repr=False)
     _config: Optional[ProtocolConfig] = field(default=None, repr=False)
     _done_evt: threading.Event = field(
+        default_factory=threading.Event, repr=False
+    )
+    # set once distribute finished for an external session (wire
+    # broadcasts available) — or at terminal, whichever comes first
+    _dist_evt: threading.Event = field(
         default_factory=threading.Event, repr=False
     )
 
@@ -464,7 +479,12 @@ class RefreshService:
         return vals[min(len(vals) - 1, int(round(0.99 * (len(vals) - 1))))]
 
     # -- session intake -------------------------------------------------
-    def submit(self, committee_id, epoch: Optional[int] = None) -> int:
+    def submit(
+        self,
+        committee_id,
+        epoch: Optional[int] = None,
+        external: bool = False,
+    ) -> int:
         """Enqueue one refresh session for the committee; returns the
         session id. With FSDKR_SERVE=0 the session runs synchronously
         (single-shot barrier semantics) before this returns.
@@ -483,9 +503,32 @@ class RefreshService:
         Without `epoch` every call is a new session (the pre-ISSUE-11
         behavior).
 
+        `external=True` makes this a NETWORK-FED session (ISSUE 13):
+        the worker still runs distribute (the service holds the
+        committee's keys), but instead of simulating the broadcast
+        channel in-process it parks the wire-serialized broadcasts for
+        the client to fetch (`wait_broadcasts`) and re-deliver
+        (`offer_external`) — the messages actually transit the network.
+        An external session can only terminate via delivered broadcasts
+        or the deadline reaper, so the service MUST have a deadline
+        (an abandoned client must not wedge its committee forever).
+
         Raises `ServeRejected` (with a retry-after hint) when the
         overload policy or the committee's bisection-storm budget sheds
         the request at admission."""
+        if external:
+            if not enabled():
+                raise ValueError(
+                    "external sessions need the scheduler (FSDKR_SERVE=0 "
+                    "runs submit synchronously; there is no window to "
+                    "deliver broadcasts into)"
+                )
+            if self.deadline_s <= 0:
+                raise ValueError(
+                    "external sessions require a session deadline "
+                    "(FSDKR_SERVE_DEADLINE_S / deadline_s > 0): an "
+                    "abandoned client would wedge its committee forever"
+                )
         now = time.monotonic()
         with self._lock:
             com = self._committees.get(committee_id)
@@ -517,6 +560,7 @@ class RefreshService:
                 committee_id=committee_id,
                 epoch=epoch,
                 submitted_at=now,
+                external=external,
             )
             if self.deadline_s > 0:
                 sess.deadline = now + self.deadline_s
@@ -583,6 +627,78 @@ class RefreshService:
                 f"{timeout}s"
             )
         return sess
+
+    # -- network-fed sessions (ISSUE 13; driven by serving.ingress) -----
+    def wait_broadcasts(
+        self, session_id: int, timeout: Optional[float] = None
+    ) -> Tuple[str, List[Tuple[int, str]]]:
+        """Block until an external session's distribute outputs exist
+        (or the session went terminal first) and return
+        ``(state, [(sender, wire_json), ...])``. The wire list is empty
+        once terminal — the caller reads the state instead. Raises
+        `TimeoutError` when `timeout` elapses, `KeyError` for unknown
+        sessions (same retention contract as `wait`)."""
+        with self._lock:
+            sess = self._sessions.get(session_id) or self._finished.get(
+                session_id
+            )
+        if sess is None:
+            raise KeyError(f"session {session_id} unknown")
+        if not sess._dist_evt.wait(timeout):
+            raise TimeoutError(
+                f"session {session_id} still {sess.state!r} after "
+                f"{timeout}s (no broadcasts yet)"
+            )
+        with self._lock:
+            return sess.state, list(sess._wire_msgs)
+
+    def offer_external(self, session_id: int, wire: str) -> str:
+        """Deliver one broadcast (wire JSON) into an external session's
+        collectors through the SAME offer path every other arrival
+        uses — journaled iff accepted, first arrival wins. Returns
+        "accepted" / "duplicate" / "unexpected" (wrong sender, or the
+        session is not network-fed) / "late" (already terminal or past
+        quorum) / "unknown" (no such session) / "pending" (distribute
+        still running — a protocol-violating client broadcasting before
+        it ever received the session's broadcast set). Raises whatever
+        the wire codec raises on an undecodable payload — the ingress
+        translates that into its malformed-frame policy. Thread-safe:
+        concurrent offers from many connections interleave freely
+        (arrival-order independence is pinned), and quorum publishes
+        exactly once via the state transition under the lock."""
+        from ..protocol.serialization import refresh_message_from_json
+
+        with self._lock:
+            sess = self._sessions.get(session_id)
+            if sess is None:
+                return (
+                    "late" if session_id in self._finished else "unknown"
+                )
+            if not sess.external:
+                return "unexpected"
+            if sess.state in TERMINAL or sess.state in (
+                "ready", "finalizing",
+            ):
+                return "late"
+            streams = list(sess._streams)
+            if not streams:
+                return "pending"
+        msg = refresh_message_from_json(wire)  # codec outside the lock
+        res = self._offer_all(sess, streams, msg, wire=wire)
+        if res == "accepted":
+            with self._lock:
+                if (
+                    sess.state == "collecting"
+                    and sess._streams
+                    and all(st.ready for st in sess._streams)
+                ):
+                    # exactly-one publish: the state transition is the
+                    # guard (a racing offer sees "ready" and stops)
+                    sess.state = "ready"
+                    sess.quorum_at = time.monotonic()
+                    self._ready.append(sess.session_id)
+                    self._ready_cv.notify()
+        return res
 
     def drain(self, timeout: float = 60.0) -> bool:
         """Block until every submitted session finished (True) or the
@@ -708,7 +824,16 @@ class RefreshService:
             if sess.state in TERMINAL:
                 return  # the reaper settled it first
             transient = not isinstance(e, FsDkrError)
-            if transient and sess.retries < self.retries:
+            # external sessions never requeue: a retried attempt would
+            # re-run distribute with FRESH randomness, and the client
+            # may already hold (and re-deliver) the failed attempt's
+            # broadcasts — pairing one attempt's messages with
+            # another's secrets is exactly the replay shape recovery
+            # forbids. The failed epoch drops its dedupe entry at
+            # _finish, so the client's resubmit starts a clean session
+            # under a NEW sid (stale broadcasts to the old sid are
+            # "late", never mixed in).
+            if transient and sess.retries < self.retries and not sess.external:
                 sess.retries += 1
                 backoff = self.backoff_s * (2 ** (sess.retries - 1))
                 backoff *= 1.0 + random.random()  # jitter: decorrelate herds
@@ -769,7 +894,11 @@ class RefreshService:
         # full dropped-sender set (precedence per message: drop >
         # tamper > delay > dup)
         actions: Dict[int, Optional[str]] = {}
-        if plan is not None:
+        if plan is not None and not sess.external:
+            # external sessions skip the in-process arrival simulation
+            # entirely — their chaos is the NETWORK's (conn_drop /
+            # frame_truncate / net_* fire at the ingress, and the client
+            # is free to drop/duplicate/tamper what it re-broadcasts)
             for k in keys:
                 pid = k.i
                 for site in ("msg_drop", "msg_tamper", "msg_delay",
@@ -799,6 +928,27 @@ class RefreshService:
             RefreshMessage.collect_stream(k, results[idx][1], expected, (), config)
             for idx, k in enumerate(keys)
         ]
+        if sess.external:
+            # network-fed: serialize the broadcasts ONCE (public wire
+            # encoding), park them for the client, and hand the session
+            # to the collecting state — every delivery from here on
+            # comes through offer_external (ingress) or dies at the
+            # deadline, which names the senders the network lost
+            wire_msgs = [
+                (m.party_index, refresh_message_to_json(m)) for m in msgs
+            ]
+            with self._lock:
+                if sess.state in TERMINAL:
+                    for st in streams:
+                        st.close(RuntimeError("session already settled"))
+                    return
+                sess._streams = streams
+                sess._config = config
+                sess._wire_msgs = wire_msgs
+                sess.state = "collecting"
+                self._reap_cv.notify()
+            sess._dist_evt.set()
+            return
         # simulated broadcast arrival: each message lands at every
         # collector before the next arrives; order is session-seeded so
         # reordering is exercised continuously in production-like runs.
@@ -1143,6 +1293,7 @@ class RefreshService:
             sess.finalized_at = now
             sess._streams = []
             sess._pending = []
+            sess._wire_msgs = []
             if error is not None:
                 sess.blame = isinstance(error, FsDkrError)
                 sess.error = f"{type(error).__name__}: {error}"
@@ -1199,6 +1350,8 @@ class RefreshService:
         if final_state == "done":
             self.planner.retarget(sess.committee_id)
             precompute.kick()
+        # a terminal state also releases any wait_broadcasts() waiter
+        sess._dist_evt.set()
         sess._done_evt.set()
 
     def _trim_history_locked(self) -> None:

@@ -83,8 +83,10 @@ def _emit(lock: threading.Lock, obj: dict) -> None:
 
 def _shard_main(args) -> int:
     """One shard: a RefreshService with a journal, driven by JSON-line
-    commands on stdin, reporting events on stdout. Runs until stdin
-    closes or a ``stop`` command arrives."""
+    commands on stdin, reporting events on stdout — and, with
+    ``--ingress-port`` (ISSUE 13), by wire-protocol clients on a TCP
+    socket (`serving.ingress`). Runs until stdin closes or a ``stop``
+    command arrives."""
     from ..protocol.serialization import local_key_from_json
     from ..telemetry import flight
     from . import recovery
@@ -100,7 +102,34 @@ def _shard_main(args) -> int:
     svc.start()
     stop_evt = threading.Event()
 
+    # network ingress (ISSUE 13): committees this shard does not own
+    # redirect to the fleet's port map (installed by the parent's
+    # `ingress_peers` command once every shard reported its bound
+    # port). The HINT is the fingerprint owner; failover reassignments
+    # override fingerprints, so clients fall back to trying the rest.
+    peer_ports: Dict[int, int] = {}
+
+    def _router(cid):
+        if not peer_ports:
+            return None
+        hint = peer_ports.get(shard_for(cid, args.shards))
+        return {
+            "ports": {str(k): v for k, v in peer_ports.items()},
+            "hint": hint,
+        }
+
+    ingress = None
+    if args.ingress_port >= 0:
+        from .ingress import IngressServer
+
+        ingress = IngressServer(
+            svc, host=args.ingress_host, port=args.ingress_port,
+            router=_router,
+        ).start()
+
     def heartbeat():
+        from . import metrics as smetrics
+
         while not stop_evt.wait(args.hb_interval):
             try:
                 flight.dump(reason="heartbeat")  # postmortem-in-waiting
@@ -111,6 +140,10 @@ def _shard_main(args) -> int:
                 "shard": args.shard_id,
                 "stats": svc.stats(),
                 "journal": svc.journal_stats(),
+                "ingress": (
+                    smetrics.ingress_snapshot()
+                    if ingress is not None else None
+                ),
             })
 
     def waiter(cid, epoch, sid):
@@ -131,7 +164,10 @@ def _shard_main(args) -> int:
         })
 
     threading.Thread(target=heartbeat, daemon=True, name="shard-hb").start()
-    _emit(out_lock, {"ev": "ready", "shard": args.shard_id, "pid": os.getpid()})
+    _emit(out_lock, {
+        "ev": "ready", "shard": args.shard_id, "pid": os.getpid(),
+        "ingress_port": ingress.port if ingress is not None else None,
+    })
 
     for line in sys.stdin:
         line = line.strip()
@@ -177,6 +213,12 @@ def _shard_main(args) -> int:
                 if svc.journal is not None:
                     svc.journal.sync()
                 _emit(out_lock, {"ev": "synced", "shard": args.shard_id})
+            elif op == "ingress_peers":
+                peer_ports.clear()
+                peer_ports.update(
+                    {int(k): int(v) for k, v in cmd["ports"].items()}
+                )
+                _emit(out_lock, {"ev": "peers_set", "shard": args.shard_id})
             elif op == "stop":
                 break
             else:
@@ -187,6 +229,8 @@ def _shard_main(args) -> int:
                 "detail": f"{type(e).__name__}: {e}",
             })
     stop_evt.set()
+    if ingress is not None:
+        ingress.stop()  # drain first: stop accepting, answer in-flight
     svc.stop()
     try:
         flight.dump(reason="shard-exit")
@@ -207,6 +251,7 @@ class ShardHandle:
         self.journal_dir = journal_dir
         self.flight_path = journal_dir / "flight.json"
         self.stderr_path = journal_dir / "stderr.log"
+        self.ingress_port: Optional[int] = None
         self.alive = True
         self.ready = False
         self.stopped = False  # clean shutdown acknowledged
@@ -214,6 +259,7 @@ class ShardHandle:
         self.last_hb = time.monotonic()
         self.last_stats: dict = {}
         self.last_journal: dict = {}
+        self.last_ingress: dict = {}
         self.committees: set = set()
 
 
@@ -236,6 +282,8 @@ class ShardSupervisor:
         spawn_timeout: float = 240.0,
         max_resubmits: int = 2,
         env: Optional[dict] = None,
+        ingress: bool = False,
+        ingress_host: str = "127.0.0.1",
     ):
         self.n_shards = max(1, int(shards))
         self.root = pathlib.Path(root) if root else pathlib.Path(
@@ -249,6 +297,12 @@ class ShardSupervisor:
         self.spawn_timeout = spawn_timeout
         self.max_resubmits = max_resubmits
         self.extra_env = dict(env or {})
+        # ISSUE 13: each shard listens on a TCP ingress port (kernel-
+        # assigned, reported in its ready event); after start() the
+        # parent broadcasts the port map so shards can redirect clients
+        # for committees they do not own
+        self.ingress = bool(ingress)
+        self.ingress_host = ingress_host
         self.shards: List[ShardHandle] = []
         self.events: "queue.Queue[Tuple[int, dict]]" = queue.Queue()
         self.assignment: Dict[object, int] = {}
@@ -273,9 +327,22 @@ class ShardSupervisor:
         while time.monotonic() < deadline:
             self.pump(0.2, health=False)
             if all(h.ready for h in self.shards):
+                if self.ingress:
+                    ports = self.ingress_ports()
+                    for h in self.shards:
+                        self._send(h, {"cmd": "ingress_peers",
+                                       "ports": ports})
                 return
         missing = [h.idx for h in self.shards if not h.ready]
         raise RuntimeError(f"shards never became ready: {missing}")
+
+    def ingress_ports(self) -> Dict[int, int]:
+        """Live shards' TCP ingress ports (empty unless ingress=True)."""
+        return {
+            h.idx: h.ingress_port
+            for h in self.shards
+            if h.alive and h.ingress_port is not None
+        }
 
     def _spawn(self, idx: int) -> ShardHandle:
         jdir = self.root / f"shard{idx:02d}"
@@ -294,6 +361,9 @@ class ShardSupervisor:
                 "--retries", str(self.retries),
                 "--workers", str(self.workers),
                 "--hb-interval", str(self.hb_interval),
+                "--shards", str(self.n_shards),
+                "--ingress-port", "0" if self.ingress else "-1",
+                "--ingress-host", self.ingress_host,
             ],
             stdin=subprocess.PIPE,
             stdout=subprocess.PIPE,
@@ -426,11 +496,13 @@ class ShardSupervisor:
         kind = ev.get("ev")
         if kind == "ready":
             h.ready = True
+            h.ingress_port = ev.get("ingress_port")
             h.last_hb = time.monotonic()
         elif kind == "hb":
             h.last_hb = time.monotonic()
             h.last_stats = ev.get("stats") or {}
             h.last_journal = ev.get("journal") or {}
+            h.last_ingress = ev.get("ingress") or {}
         elif kind == "terminal":
             self._resolve(idx, ev)
         elif kind == "rejected":
@@ -561,6 +633,14 @@ class ShardSupervisor:
             self.assignment[cid] = peer.idx
             peer.committees.add(cid)
         dead.committees.clear()
+        # peer hygiene (ISSUE 13): refresh every live shard's redirect
+        # port map so no redirect keeps steering clients at the dead
+        # shard's port — the fingerprint hint dies with the shard, the
+        # ports list shrinks to the living
+        ports = self.ingress_ports()
+        if ports:
+            for h in self._alive():
+                self._send(h, {"cmd": "ingress_peers", "ports": ports})
         self._send(peer, {"cmd": "recover", "dir": str(dead.journal_dir)})
         # resubmit every unresolved epoch the dead shard owned; the
         # peer's restored idempotency index replays done epochs
@@ -610,6 +690,15 @@ class ShardSupervisor:
         contribute their final beat — the aggregate survives kills)."""
         agg: Dict[str, float] = {}
         jagg: Dict[str, float] = {}
+        iagg: Dict[str, object] = {}
+
+        def _merge(into: dict, frm: dict) -> None:
+            for k, v in frm.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    into[k] = into.get(k, 0) + v
+                elif isinstance(v, dict):
+                    _merge(into.setdefault(k, {}), v)
+
         for h in self.shards:
             for k, v in (h.last_stats or {}).items():
                 if isinstance(v, (int, float)):
@@ -617,6 +706,7 @@ class ShardSupervisor:
             for k, v in (h.last_journal or {}).items():
                 if isinstance(v, (int, float)):
                     jagg[k] = jagg.get(k, 0) + v
+            _merge(iagg, h.last_ingress or {})
         return {
             "shards": self.n_shards,
             "alive": len(self._alive()),
@@ -627,6 +717,7 @@ class ShardSupervisor:
             ],
             "serving": agg,
             "journal": jagg,
+            "ingress": iagg,
         }
 
 
@@ -643,6 +734,12 @@ def main(argv=None) -> int:
     p.add_argument("--retries", type=int, default=2)
     p.add_argument("--workers", type=int, default=1)
     p.add_argument("--hb-interval", type=float, default=0.5)
+    p.add_argument("--shards", type=int, default=1,
+                   help="fleet shard count (redirect fingerprint hints)")
+    p.add_argument("--ingress-port", type=int, default=-1,
+                   help="TCP ingress port (0 = kernel-assigned, "
+                        "-1 = no ingress)")
+    p.add_argument("--ingress-host", default="127.0.0.1")
     args = p.parse_args(argv)
     if not args.shard:
         p.error("supervisor is a library; only --shard mode runs directly "

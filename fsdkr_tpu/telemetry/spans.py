@@ -216,6 +216,13 @@ class Tracer:
                 self._attrs_dropped += dropped
         tok = _STACK.set(_STACK.get() + ((self, span),))
         t0 = time.perf_counter()
+        # re-stamp t0 at the instant the duration clock starts: the
+        # constructor stamped it a few µs earlier (sanitize + contextvar
+        # work in between), and t1 = t0 + dt with MISMATCHED origins
+        # under-reported each span's end by its own construction gap —
+        # a parent with a bigger gap could "end" microseconds before
+        # its child, breaking interval nesting
+        span.t0 = t0 - _T0_PERF
         try:
             yield
         finally:

@@ -279,4 +279,87 @@ print("kill-recovery smoke ok: killed shard", killed,
       fo["recovery"]["replayed_terminal"], "| recovered via", rec["via"])
 EOF
 
+echo "== test: ingress smoke leg (networked 2-shard supervisor + conn_drop storm) =="
+# the kill-recovery leg above feeds shards over private pipes; this leg
+# feeds them over REAL TCP sockets (ISSUE 13): a 2-shard supervisor
+# with ingress ports, epoch 0 as the in-process (pipe-fed) control,
+# epoch 1 driven through the wire protocol — dialing the WRONG shard
+# first so the redirect path is exercised — asserting the socket-fed
+# verdict matches the control; then a fixed-seed network-chaos storm
+# (conn_drop / frame_truncate / net_delay / net_dup) through the
+# multi-process client loadgen asserting zero wedged sessions, zero
+# wrong verdicts, zero lost accepted broadcasts, and a clean drain
+python - <<'EOF'
+from fsdkr_tpu.config import TEST_CONFIG
+from fsdkr_tpu.protocol import simulate_keygen
+from fsdkr_tpu.serving.supervisor import ShardSupervisor, shard_for
+from fsdkr_tpu.serving.ingress import IngressClient
+import tempfile
+
+root = tempfile.mkdtemp(prefix="fsdkr_ci_ingress_")
+sup = ShardSupervisor(shards=2, root=root, deadline_s=20.0,
+                      hb_interval=0.4, ingress=True)
+sup.start()
+ports = sup.ingress_ports()
+assert len(ports) == 2, ports
+cids, want, i = [], {0, 1}, 0
+while want:  # one committee per shard under the fingerprint partition
+    cid = f"com{i}"
+    if shard_for(cid, 2) in want:
+        want.discard(shard_for(cid, 2)); cids.append(cid)
+    i += 1
+keys = simulate_keygen(1, 3, TEST_CONFIG)
+for cid in cids:
+    sup.admit(cid, [k.clone() for k in keys], TEST_CONFIG)
+for cid in cids:
+    sup.submit(cid, 0)           # the in-process control epoch
+assert sup.drain(240), f"control epoch wedged: {sup.pending}"
+control = {(o["cid"], o["epoch"]): o for o in sup.outcomes}
+assert all(o["state"] == "done" and not o["blame"]
+           for o in control.values()), control
+cid = cids[0]
+owner = shard_for(cid, 2)
+cli = IngressClient("127.0.0.1", ports[1 - owner])  # wrong shard first
+r = cli.submit(cid, epoch=1)
+assert r["type"] == "redirect" and r["hint"] == ports[owner], r
+cli.close()
+cli = IngressClient("127.0.0.1", int(r["hint"]))
+r = cli.submit(cid, epoch=1)
+assert r["type"] == "submitted", r
+for snd, wire in r["broadcasts"]:
+    assert cli.broadcast(r["sid"], wire)["result"] == "accepted"
+term = cli.wait(r["sid"], 120)
+assert term["state"] == "done" and not term["blame"], term
+cli.close()
+sup.pump(0.5)
+agg = sup.aggregate()
+assert agg["ingress"].get("frames", {}).get("in", 0) >= 5, agg["ingress"]
+sup.stop()
+print("ingress smoke ok: socket-fed verdict matches in-process control "
+      "(done/no-blame), redirect exercised, ingress frames in heartbeats")
+EOF
+rm -f /tmp/fsdkr_ci_net.json
+python scripts/loadgen.py --net --committees 4 --bases 2 --shards 2 \
+  --clients 2 --window 8 --rate 1.5 --baseline-window 5 --deadline 8 \
+  --kills 0 --seed 42 --drain-timeout 180 \
+  --net-faults "seed=42,conn_drop=0.12,frame_truncate=0.05,net_delay=0.1,net_dup=0.1,delay_s=0.2" \
+  --out /tmp/fsdkr_ci_net.json > /dev/null
+python - <<'EOF'
+import json
+rep = json.load(open("/tmp/fsdkr_ci_net.json"))
+g = rep["gates"]
+assert g["zero_wedged"], rep["outcomes"]
+assert g["zero_wrong_verdicts"], rep["wrong_detail"]
+assert g["zero_lost_broadcasts"], rep["lost_detail"]
+assert g["fleet_quiesced"], "fleet did not drain clean"
+done = rep["outcomes"]["done_clean"] + rep["outcomes"]["recovered"]
+assert done > 0, rep["outcomes"]
+ing = rep["aggregate"]["ingress"]
+assert ing.get("frames", {}).get("in", 0) > 0, ing
+print("ingress conn_drop storm ok:", rep["outcomes"],
+      "| client counters", rep["client_counters"],
+      "| net", rep["net_sessions_per_s"], "/s vs in-process",
+      rep["in_process_baseline"]["sessions_per_s"], "/s")
+EOF
+
 echo "== ci.sh: all gates green =="

@@ -73,7 +73,7 @@ def main():
     root = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else "bench_results")
     configs, kernels, traces, ec_ab = [], [], {}, []
     mfu, other_kernel_recs = [], 0
-    serving, chaos, storms = [], [], []
+    serving, chaos, storms, net_storms = [], [], [], []
     # serving reports live both as battery steps (m_serve_*.json) and as
     # the loadgen's own serving_*.json artifacts; the cpu_scale_* /
     # cpu_full_* structural and full-width runs digest too (ISSUE 10),
@@ -84,6 +84,7 @@ def main():
         + sorted(root.glob("serving_*.json"))
         + sorted(root.glob("chaos_*.json"))
         + sorted(root.glob("crash_storm*.json"))
+        + sorted(root.glob("net_storm*.json"))
         + sorted(root.glob("cpu_scale_*.json"))
         + sorted(root.glob("cpu_full_*.json"))
     )
@@ -117,6 +118,8 @@ def main():
                 chaos.append((name, rec))
             elif rec.get("metric") == "serve_crash_storm":
                 storms.append((name, rec))
+            elif rec.get("metric") == "serve_net_storm":
+                net_storms.append((name, rec))
             elif "metric" in rec:
                 configs.append((name, rec))
                 if rec.get("trace"):
@@ -445,6 +448,59 @@ def main():
                     f"{int(jagg.get('segments', 0))} segments, "
                     f"{int(jagg.get('fsyncs', 0))} fsyncs\n"
                 )
+
+    if net_storms:
+        # network-fed serving storms (ISSUE 13, scripts/loadgen.py --net)
+        print("### network storm: socket-fed serving under net chaos "
+              "(loadgen --net)\n")
+        print("| step | shards | clients | kills | epochs | clean "
+              "| recovered | transient | timed out | lost | wrong "
+              "| wedged | bystander p99 | net /s (per core) "
+              "| in-proc /s | gates |")
+        print("|---|---|---|---|---|---|---|---|---|---|---|---|---|"
+              "---|---|---|")
+        for name, r in net_storms:
+            out = r.get("outcomes") or {}
+            gates = r.get("gates") or {}
+            gate_s = "ok" if gates and all(gates.values()) else ",".join(
+                k for k, v in gates.items() if not v
+            ) or "—"
+            base = r.get("in_process_baseline") or {}
+            print(
+                f"| {name} | {r.get('shards', '—')} "
+                f"| {r.get('clients', '—')} "
+                f"| {r.get('kills_injected', 0)} "
+                f"| {r.get('epochs_submitted', '—')} "
+                f"| {out.get('done_clean', '—')} "
+                f"| {out.get('recovered', '—')} "
+                f"| {out.get('aborted_transient', 0)} "
+                f"| {out.get('timed_out', 0)} "
+                f"| {r.get('lost_broadcast_sessions', '—')} "
+                f"| {r.get('wrong_verdicts', '—')} "
+                f"| {r.get('wedged', '—')} "
+                f"| {r.get('bystander_p99_s', '—')}s "
+                f"| {r.get('net_sessions_per_s', '—')} "
+                f"({r.get('net_sessions_per_s_per_core', '—')}) "
+                f"| {base.get('sessions_per_s', '—')} "
+                f"| {gate_s} |"
+            )
+        print()
+        for name, r in net_storms:
+            ing = (r.get("aggregate") or {}).get("ingress") or {}
+            if not ing:
+                continue
+            print(f"#### ingress rollup: {name} (shard heartbeats)\n")
+            print("| counter | value |")
+            print("|---|---|")
+            for k in ("connections", "frames", "bytes",
+                      "frames_rejected", "paused_reads"):
+                for lk, v in sorted((ing.get(k) or {}).items()):
+                    print(f"| {k}{{{lk}}} | {int(v)} |")
+            print(f"| peer_rate_shed | {int(ing.get('peer_rate_shed', 0))} |")
+            cc = r.get("client_counters") or {}
+            for k in sorted(cc):
+                print(f"| clients.{k} | {int(cc[k])} |")
+            print()
 
     if kernels:
         print("### kernel sweep (modexp rows/s, real chip)\n")

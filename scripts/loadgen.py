@@ -69,6 +69,15 @@ DEFAULT_FAULTS = (
     "delay_s=0.4,squeeze_factor=0.25"
 )
 
+# network-chaos storm (ISSUE 13): per-frame rates at the ingress — a
+# session exchanges ~8-10 frames, so a few percent per frame hits a
+# large fraction of sessions with at least one dropped connection,
+# torn response, duplicated response, or delayed answer
+DEFAULT_NET_FAULTS = (
+    "conn_drop=0.04,frame_truncate=0.02,net_delay=0.08,net_dup=0.06,"
+    "delay_s=0.3"
+)
+
 
 def parse_args():
     p = argparse.ArgumentParser(description=__doc__)
@@ -131,9 +140,11 @@ def parse_args():
                         "bench_results/crash_storm.json")
     p.add_argument("--shards", type=int, default=4,
                    help="shard processes under the supervisor")
-    p.add_argument("--kills", type=int, default=3,
+    p.add_argument("--kills", type=int, default=None,
                    help="shard SIGKILLs injected across the window "
-                        "(the shard_kill fault site)")
+                        "(the shard_kill fault site; default 3 for "
+                        "--crash-storm, 0 for --net — network chaos "
+                        "composes with kills only when asked)")
     p.add_argument("--journal-root", default=None,
                    help="journal root directory (default: a temp dir; "
                         "journals hold PUBLIC data only)")
@@ -141,6 +152,25 @@ def parse_args():
                    help="journal THIS run's single service to the given "
                         "directory (durability A/B for sustained/chaos "
                         "windows; the report gains a `journal` block)")
+    # ---- network mode (ISSUE 13) -------------------------------------
+    p.add_argument("--net", action="store_true",
+                   help="multi-process network storm: client processes "
+                        "speak the wire protocol over real TCP sockets "
+                        "against an ingress-enabled ShardSupervisor; "
+                        "emits bench_results/net_storm.json (combine "
+                        "with --kills N for the crash x network storm)")
+    p.add_argument("--clients", type=int, default=2,
+                   help="wire-protocol client processes (--net)")
+    p.add_argument("--net-faults", default=None,
+                   help="server-side network fault spec armed in every "
+                        "shard (conn_drop/frame_truncate/net_delay/"
+                        "net_dup; default: the net storm spec with "
+                        "--seed appended; '' = no network chaos)")
+    p.add_argument("--max-attempts", type=int, default=5,
+                   help="client resubmit attempts per epoch before it "
+                        "counts as unresolved/wedged (--net)")
+    p.add_argument("--net-client", action="store_true",
+                   help=argparse.SUPPRESS)  # internal: client worker
     return p.parse_args()
 
 
@@ -302,6 +332,205 @@ def run_tamper_curve(svc, cids, rates, sessions_per_rate, seed, drain_timeout,
     return curve
 
 
+def run_net_client():
+    """Internal worker for --net (spawned as `loadgen.py --net-client`):
+    one wire-protocol client process. Reads its spec as one JSON line on
+    stdin, prints `{"ev": "ready"}`, waits for a `go` line, runs a
+    Poisson window of refresh epochs over its assigned committees
+    ENTIRELY over TCP (submit -> receive the broadcast set -> re-deliver
+    every broadcast -> wait for the verdict), and prints one result JSON
+    line. The client IS the broadcast channel: it retries through
+    redirects, rejections, dropped connections, and torn frames —
+    reconnect + idempotent resubmit — and classifies what it observed."""
+    import threading
+
+    from fsdkr_tpu.serving.ingress import IngressClient
+    from fsdkr_tpu.serving.supervisor import shard_for
+
+    spec = json.loads(sys.stdin.readline())
+    ports = [int(p) for p in spec["ports"].values()]
+    port_of_shard = {int(k): int(v) for k, v in spec["ports"].items()}
+    n_shards = int(spec["shards"])
+    committees = list(spec["committees"])
+    epochs = {int(c): int(e) for c, e in spec["epochs"]}
+    rate = float(spec["rate_hz"])
+    window_s = float(spec["window_s"])
+    deadline_s = float(spec["deadline_s"])
+    max_attempts = int(spec["max_attempts"])
+    op_timeout = float(spec.get("op_timeout_s", 30.0))
+    rng = random.Random(int(spec["seed"]))
+    counters = {"reconnects": 0, "redirects": 0, "rejected": 0,
+                "unknown_committee_retries": 0, "sessions_started": 0}
+    clock = {"lock": threading.Lock()}
+
+    def count(k, n=1):
+        with clock["lock"]:
+            counters[k] = counters.get(k, 0) + n
+
+    def run_epoch(cid, epoch, out):
+        t0 = time.monotonic()
+        attempts = reconnects = redirects = 0
+        # first dial: the fingerprint owner (failover may override —
+        # the redirect response re-routes us)
+        port = port_of_shard.get(shard_for(cid, n_shards), ports[0])
+        ports_cycle = [port] + [p for p in ports if p != port]
+        cycle_i = 0
+        cli = None
+        outcome = None
+        budget = t0 + deadline_s * (max_attempts + 1) + 60.0
+        while outcome is None and attempts < max_attempts \
+                and time.monotonic() < budget:
+            attempts += 1
+            try:
+                if cli is None:
+                    cli = IngressClient("127.0.0.1", port,
+                                        timeout=op_timeout)
+                r = cli.submit(cid, epoch, timeout=op_timeout)
+                typ = r.get("type")
+                if typ == "redirect":
+                    redirects += 1
+                    count("redirects")
+                    attempts -= 1  # routing, not a failed attempt
+                    hint = r.get("hint")
+                    new_port = int(hint) if hint else None
+                    if new_port is None or new_port == port:
+                        pp = [int(v) for v in (r.get("ports") or {}).values()]
+                        alt = [p for p in (pp or ports) if p != port]
+                        new_port = alt[0] if alt else port
+                    port = new_port
+                    cli.close()
+                    cli = None
+                    continue
+                if typ == "rejected":
+                    count("rejected")
+                    attempts -= 1  # shed is an answer, not an attempt
+                    time.sleep(min(1.0, float(r.get("retry_after_s", 0.1))))
+                    continue
+                if typ == "error":
+                    if r.get("error") == "unknown_committee":
+                        # failover in flight: the committee is between
+                        # shards — rotate ports until someone owns it
+                        # (routing churn, not a protocol attempt; the
+                        # wall-clock budget bounds the loop)
+                        count("unknown_committee_retries")
+                        attempts -= 1
+                        cycle_i += 1
+                        port = ports_cycle[cycle_i % len(ports_cycle)]
+                        cli.close()
+                        cli = None
+                        time.sleep(0.2)
+                        continue
+                    time.sleep(0.2)
+                    continue
+                sid = r["sid"]
+                count("sessions_started")
+                if r.get("state") in ("done", "aborted", "timed_out"):
+                    # idempotent dedupe handed back a finished epoch
+                    # (e.g. replayed after failover): that IS the verdict
+                    outcome = {"state": r["state"],
+                               "blame": bool(r.get("blame")),
+                               "error": r.get("error")}
+                    break
+                bcasts = r.get("broadcasts")
+                if bcasts is None:
+                    f = cli.fetch(sid, timeout=op_timeout)
+                    bcasts = f.get("broadcasts") or []
+                rng.shuffle(bcasts)  # arrival order must not matter
+                resubmit = False
+                for snd, wire in bcasts:
+                    ack = cli.broadcast(sid, wire, timeout=op_timeout)
+                    if ack.get("type") != "broadcast_ack":
+                        resubmit = True
+                        break
+                    if ack.get("result") == "unknown":
+                        # the session died with its shard: start over
+                        resubmit = True
+                        break
+                if resubmit:
+                    continue
+                term = cli.wait(sid, deadline_s + 10.0)
+                if term.get("type") == "error" \
+                        and term.get("error") == "timeout":
+                    term = cli.wait(sid, deadline_s + 10.0)  # once more
+                if term.get("type") != "terminal":
+                    continue
+                st = term["state"]
+                outcome = {"state": st, "blame": bool(term.get("blame")),
+                           "error": term.get("error"),
+                           "server_latency_s": term.get("latency_s")}
+                if st == "done" or (st == "aborted" and outcome["blame"]):
+                    break  # verdicts are final; transients retry
+                outcome = None if attempts < max_attempts else outcome
+            except (ConnectionError, OSError):
+                # a network failure is NOT a protocol attempt: rotate
+                # ports and redial (the wall-clock budget bounds a
+                # fully-dead fleet; attempts bound protocol retries —
+                # burning them on a refused dial would wedge an epoch
+                # behind one failover's connection churn)
+                attempts -= 1
+                reconnects += 1
+                count("reconnects")
+                if cli is not None:
+                    cli.close()
+                    cli = None
+                cycle_i += 1
+                port = ports_cycle[cycle_i % len(ports_cycle)]
+                time.sleep(min(1.0, 0.05 * (reconnects + attempts)))
+        if cli is not None:
+            cli.close()
+        if outcome is None:
+            outcome = {"state": "unresolved", "blame": False,
+                       "error": "client attempts exhausted"}
+        outcome.update(
+            cid=cid, epoch=epoch, attempts=attempts,
+            reconnects=reconnects, redirects=redirects,
+            latency_s=round(time.monotonic() - t0, 4),
+        )
+        out.append(outcome)
+
+    print(json.dumps({"ev": "ready"}), flush=True)
+    go = sys.stdin.readline()  # parent's start barrier
+    if not go:
+        return 1
+    outcomes = []
+    busy = {}
+    threads = []
+    t_win = time.monotonic()
+    next_arrival = t_win
+    while time.monotonic() - t_win < window_s:
+        now = time.monotonic()
+        if now < next_arrival:
+            time.sleep(min(0.01, next_arrival - now))
+            continue
+        next_arrival += rng.expovariate(rate)
+        idle = [c for c in committees
+                if not (busy.get(c) and busy[c].is_alive())]
+        if not idle:
+            continue  # every committee has an epoch in flight
+        cid = rng.choice(idle)
+        epoch = epochs[cid]
+        epochs[cid] = epoch + 1
+        th = threading.Thread(
+            target=run_epoch, args=(cid, epoch, outcomes), daemon=True
+        )
+        busy[cid] = th
+        threads.append(th)
+        th.start()
+    join_deadline = time.monotonic() + deadline_s * (max_attempts + 1) + 90
+    for th in threads:
+        th.join(timeout=max(1.0, join_deadline - time.monotonic()))
+    still = sum(th.is_alive() for th in threads)
+    print(json.dumps({
+        "ev": "result",
+        "client_id": spec.get("client_id"),
+        "window_s": round(time.monotonic() - t_win, 2),
+        "outcomes": outcomes,
+        "counters": counters,
+        "threads_unjoined": still,
+    }, default=str), flush=True)
+    return 0
+
+
 def run_crash_storm(args):
     """ISSUE 12 acceptance harness: Poisson refresh arrivals over a
     multi-process ShardSupervisor while the `shard_kill` fault site
@@ -319,6 +548,8 @@ def run_crash_storm(args):
     from fsdkr_tpu.serving.supervisor import ShardSupervisor
     from fsdkr_tpu.telemetry import export as tel_export
 
+    if args.kills is None:
+        args.kills = 3  # the crash storm's whole point
     log = lambda *a: print(*a, file=sys.stderr, flush=True)
     t_start = time.time()
     config = ProtocolConfig(
@@ -547,8 +778,398 @@ def run_crash_storm(args):
     return 0 if all(report["gates"].values()) else 1
 
 
+def run_net_storm(args):
+    """ISSUE 13 acceptance harness: multi-process wire-protocol clients
+    over real TCP sockets against an ingress-enabled ShardSupervisor,
+    under server-side network chaos (conn_drop / frame_truncate /
+    net_delay / net_dup) and — with --kills — composed with shard
+    SIGKILLs. Gates: zero wrong verdicts (no tampering injected -> any
+    blame is wrong), zero wedged sessions (client attempts exhausted),
+    zero lost ACCEPTED broadcasts (every journal audited), and the
+    healthy-bystander p99 under the stated bound. Also documents the
+    networked sessions/s-per-core against the in-process (pipe-fed)
+    baseline window — the ROADMAP item 3 done-criterion."""
+    import subprocess
+    import tempfile
+    import threading
+
+    from fsdkr_tpu.config import ProtocolConfig
+    from fsdkr_tpu.protocol import simulate_keygen
+    from fsdkr_tpu.serving import faults, recovery
+    from fsdkr_tpu.serving.supervisor import ShardSupervisor
+
+    if args.kills is None:
+        args.kills = 0  # kills compose with network chaos only by request
+    log = lambda *a: print(*a, file=sys.stderr, flush=True)
+    t_start = time.time()
+    config = ProtocolConfig(
+        paillier_bits=args.bits,
+        m_security=args.m_security,
+        correct_key_rounds=args.ck_rounds,
+        backend=args.backend,
+    )
+    rng = random.Random(args.seed)
+    rate = args.rate or 1.0
+    deadline_s = args.deadline or 8.0
+    root = args.journal_root or tempfile.mkdtemp(prefix="fsdkr_net_")
+    net_spec = args.net_faults
+    if net_spec is None:
+        net_spec = f"{DEFAULT_NET_FAULTS},seed={args.seed}"
+    kill_plan = None
+    if args.kills > 0:
+        kill_plan = faults.configure(
+            f"seed={args.seed},shard_kill=1.0,shard_kill_max={args.kills}"
+        )
+
+    log(f"[net] keygen {args.bases} base committees "
+        f"(n={args.n}, t={args.t}, {args.bits}-bit)")
+    t0 = time.time()
+    keygen = getattr(simulate_keygen, "uncached", simulate_keygen)
+    bases = [keygen(args.t, args.n, config) for _ in range(args.bases)]
+    committees = {
+        cid: [k.clone() for k in bases[cid % args.bases]]
+        for cid in range(args.committees)
+    }
+    keygen_s = time.time() - t0
+
+    # shards carry the NETWORK fault plan via env — the sites act only
+    # at the ingress, so the pipe-fed seed/baseline stays chaos-free
+    env = {"FSDKR_FAULTS": net_spec} if net_spec else {}
+    sup = ShardSupervisor(
+        shards=args.shards,
+        root=root,
+        deadline_s=deadline_s,
+        retries=args.retries if args.retries is not None else 2,
+        hb_interval=0.3,
+        ingress=True,
+        env=env,
+    )
+    t0 = time.time()
+    sup.start()
+    ports = sup.ingress_ports()
+    log(f"[net] {args.shards} shards ready in {time.time() - t0:.1f}s, "
+        f"ingress ports {ports} (journals under {root})")
+    for cid, keys in committees.items():
+        sup.admit(cid, keys, config)
+
+    # seed epoch 0 via the pipes (warms shard engine caches)
+    t0 = time.time()
+    epoch_of = {cid: 0 for cid in committees}
+    for cid in committees:
+        sup.submit(cid, 0)
+        epoch_of[cid] = 1
+    if not sup.drain(timeout=max(args.drain_timeout, 10 * args.committees)):
+        log(f"[net] WARNING: seed epoch did not drain: {sup.pending}")
+    seed_s = time.time() - t0
+    sup.outcomes.clear()
+
+    # ---- in-process baseline window (pipe path, no sockets) ----------
+    bw = args.baseline_window or min(args.window, 20.0)
+    log(f"[net] in-process baseline window {bw:.0f}s at {rate}/s")
+    t_base = time.monotonic()
+    next_arrival = t_base
+    while time.monotonic() - t_base < bw:
+        now = time.monotonic()
+        if now >= next_arrival:
+            next_arrival += rng.expovariate(rate)
+            cid = rng.choice(list(committees))
+            sup.submit(cid, epoch_of[cid])
+            epoch_of[cid] += 1
+        sup.pump(0.02)
+    base_window = time.monotonic() - t_base
+    sup.drain(timeout=args.drain_timeout)
+    base_outcomes = list(sup.outcomes)
+    sup.outcomes.clear()
+    base_lat = sorted(o["latency_s"] for o in base_outcomes
+                      if o["state"] == "done" and o["latency_s"] is not None)
+    baseline = {
+        "window_s": round(base_window, 2),
+        "sessions_done": len(base_lat),
+        "sessions_per_s": round(len(base_lat) / base_window, 4),
+        "p50": percentile(base_lat, 0.50),
+        "p99": percentile(base_lat, 0.99),
+    }
+    log(f"[net] baseline: {baseline['sessions_per_s']}/s, "
+        f"p99 {baseline['p99']}s ({len(base_lat)} done in-process)")
+
+    # ---- spawn the wire-protocol client processes --------------------
+    n_clients = max(1, args.clients)
+    assignment = {i: [] for i in range(n_clients)}
+    for j, cid in enumerate(sorted(committees)):
+        assignment[j % n_clients].append(cid)
+    clients = []
+    for i in range(n_clients):
+        spec = {
+            "client_id": i,
+            "ports": {str(k): v for k, v in ports.items()},
+            "shards": args.shards,
+            "committees": assignment[i],
+            "epochs": [[c, epoch_of[c]] for c in assignment[i]],
+            "rate_hz": rate / n_clients,
+            "window_s": args.window,
+            "deadline_s": deadline_s,
+            "max_attempts": args.max_attempts,
+            "seed": args.seed * 1000 + i,
+        }
+        cenv = dict(os.environ)
+        cenv.setdefault("JAX_PLATFORMS", "cpu")
+        cenv.pop("FSDKR_FAULTS", None)  # chaos is server-side only
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--net-client"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=sys.stderr, text=True, env=cenv,
+        )
+        proc.stdin.write(json.dumps(spec) + "\n")
+        proc.stdin.flush()
+        lines = []
+        threading.Thread(
+            target=lambda p=proc, ls=lines: ls.extend(p.stdout),
+            daemon=True,
+        ).start()
+        clients.append({"proc": proc, "lines": lines, "spec": spec})
+
+    # start barrier: every client finished importing before the window
+    spawn_deadline = time.monotonic() + 300
+    for c in clients:
+        while time.monotonic() < spawn_deadline:
+            if any('"ready"' in ln for ln in c["lines"]):
+                break
+            if c["proc"].poll() is not None:
+                raise RuntimeError(
+                    f"net client {c['spec']['client_id']} died at startup"
+                )
+            time.sleep(0.1)
+    for c in clients:
+        c["proc"].stdin.write("go\n")
+        c["proc"].stdin.flush()
+    log(f"[net] {n_clients} clients started; window {args.window:.0f}s"
+        + (f" with {args.kills} shard kills" if args.kills else ""))
+
+    # ---- measured window: pump heartbeats + the kill schedule --------
+    kill_ticks = [
+        (i + 1) * args.window / (args.kills + 1) for i in range(args.kills)
+    ]
+    kills_done, killed_shards = 0, []
+    t_win = time.monotonic()
+    while any(c["proc"].poll() is None for c in clients):
+        now = time.monotonic() - t_win
+        while kill_plan and kill_ticks and now >= kill_ticks[0]:
+            tick = kill_ticks.pop(0)
+            if kill_plan.fire("shard_kill", (round(tick, 3),)):
+                alive = [h for h in sup.shards if h.alive]
+                owners = [h for h in alive if h.committees]
+                victim = rng.choice(owners or alive)
+                k = sup.kill_shard(victim.idx)
+                if k is not None:
+                    kills_done += 1
+                    killed_shards.append(k)
+                    log(f"[net] t+{now:.1f}s SIGKILL shard {k}")
+        sup.pump(0.1)
+        if now > args.window + deadline_s * (args.max_attempts + 1) + 180:
+            log("[net] WARNING: clients overran the window budget")
+            break
+    window_wall = time.monotonic() - t_win
+    faults.reset()
+
+    results = []
+    for c in clients:
+        try:
+            c["proc"].wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            c["proc"].kill()
+    time.sleep(0.5)  # let the stdout reader threads hit EOF
+    for c in clients:
+        for ln in c["lines"]:
+            try:
+                obj = json.loads(ln)
+            except ValueError:
+                continue
+            if obj.get("ev") == "result":
+                results.append(obj)
+    if len(results) != n_clients:
+        log(f"[net] WARNING: {n_clients - len(results)} clients "
+            f"returned no result")
+
+    # let in-flight deadline reaps settle, then read the fleet's last
+    # word (aggregation satellite: serving/journal/ingress roll up from
+    # SHARD heartbeats + CLIENT processes only — the parent's own
+    # registry saw keygen, not serving, and must not leak into the sums).
+    # quiescence counts ALIVE shards: a SIGKILLed shard's final
+    # heartbeat can freeze a nonzero inflight forever
+    def _alive_inflight():
+        return sum(
+            (h.last_stats or {}).get("inflight", 0)
+            for h in sup.shards if h.alive
+        )
+
+    quiesce_deadline = time.monotonic() + deadline_s + 15
+    while time.monotonic() < quiesce_deadline:
+        sup.pump(0.2)
+        if _alive_inflight() == 0:
+            break
+    agg = sup.aggregate()
+
+    # ---- classification ----------------------------------------------
+    outcomes = [o for r in results for o in r["outcomes"]]
+    moved_cids = {c for fo in agg["failovers"] for c in fo.get("moved", [])}
+    cls = {"done_clean": 0, "recovered": 0, "aborted_blame": 0,
+           "aborted_transient": 0, "timed_out": 0, "unresolved": 0}
+    wrong = []
+    bystander_lat = []
+    for o in outcomes:
+        disturbed = (o["attempts"] > 1 or o["reconnects"] > 0
+                     or o["redirects"] > 0 or o["cid"] in moved_cids)
+        if o["state"] == "done":
+            cls["recovered" if disturbed else "done_clean"] += 1
+            if not disturbed:
+                bystander_lat.append(o["latency_s"])
+        elif o["state"] == "aborted" and o["blame"]:
+            # no tampering injected anywhere: blame is wrong by
+            # construction
+            cls["aborted_blame"] += 1
+            wrong.append(f"{o['cid']}/{o['epoch']}: blamed: {o['error']}")
+        elif o["state"] == "aborted":
+            cls["aborted_transient"] += 1
+        elif o["state"] == "timed_out":
+            cls["timed_out"] += 1
+        else:
+            cls["unresolved"] += 1
+    wedged = cls["unresolved"] + sum(
+        int(r.get("threads_unjoined", 0)) for r in results
+    )
+    bystander_lat.sort()
+
+    # ---- zero-lost-accepted-broadcast audit (every journal) ----------
+    recovered_dirs = {fo["journal_dir"] for fo in agg["failovers"]
+                      if fo.get("recovery")}
+    lost_sessions = []
+    scanned = {"journals": 0, "sessions": 0, "broadcast_records": 0,
+               "terminal_records": 0}
+    for shard_dir in sorted(pathlib.Path(root).glob("shard*")):
+        sessions, _coms = recovery.load_state(shard_dir)
+        scanned["journals"] += 1
+        scanned["sessions"] += len(sessions)
+        for sid, js in sessions.items():
+            scanned["broadcast_records"] += len(js.broadcasts)
+            scanned["terminal_records"] += js.terminal is not None
+            if js.broadcasts and js.terminal is None \
+                    and str(shard_dir) not in recovered_dirs:
+                lost_sessions.append(f"{shard_dir.name}:{sid}")
+
+    client_counters = {}
+    for r in results:
+        for k, v in (r.get("counters") or {}).items():
+            client_counters[k] = client_counters.get(k, 0) + v
+    done_total = cls["done_clean"] + cls["recovered"]
+    cores = os.cpu_count() or 1
+    p99_by = percentile(bystander_lat, 0.99)
+    bound_s = (
+        round(deadline_s + args.p99_bound * baseline["p99"], 3)
+        if baseline["p99"] else None
+    )
+
+    report = {
+        "metric": "serve_net_storm",
+        "platform": "host-shards-tcp",
+        "committees": args.committees,
+        "distinct_bases": args.bases,
+        "n": args.n,
+        "t": args.t,
+        "paillier_bits": args.bits,
+        "m_security": args.m_security,
+        "shards": args.shards,
+        "clients": n_clients,
+        "window_s": args.window,
+        "window_wall_s": round(window_wall, 2),
+        "offered_rate_hz": rate,
+        "deadline_s": deadline_s,
+        "seed": args.seed,
+        "net_fault_spec": net_spec or None,
+        "kill_fault_spec": kill_plan.spec() if kill_plan else None,
+        "kills_injected": kills_done,
+        "killed_shards": killed_shards,
+        "epochs_submitted": len(outcomes),
+        "outcomes": cls,
+        "wrong_verdicts": len(wrong),
+        "wrong_detail": wrong[:8],
+        "wedged": wedged,
+        "lost_broadcast_sessions": len(lost_sessions),
+        "lost_detail": lost_sessions[:8],
+        "journal_audit": scanned,
+        "client_counters": client_counters,
+        "in_process_baseline": baseline,
+        "net_sessions_per_s": round(done_total / window_wall, 4)
+        if window_wall > 0 else None,
+        "net_sessions_per_s_per_core": round(
+            done_total / window_wall / cores, 4
+        ) if window_wall > 0 else None,
+        "in_process_sessions_per_s_per_core": round(
+            baseline["sessions_per_s"] / cores, 4
+        ),
+        "cores": cores,
+        "bystander_p99_s": p99_by,
+        "bystander_done": len(bystander_lat),
+        "p99_bound": args.p99_bound,
+        "p99_bound_s": bound_s,
+        "p99_bound_stated": "deadline_s + p99_bound * in_process_p99",
+        "failovers": agg["failovers"],
+        # satellite (ISSUE 13): serving/journal/ingress sums come from
+        # shard heartbeats + client processes ONLY — never the parent
+        # registry, which would double-count nothing real but pollute
+        # the rollup with the parent's keygen-phase counters
+        "aggregate": {k: agg[k] for k in ("serving", "journal",
+                                          "ingress", "alive")},
+        "aggregation": "shard heartbeats + client results; "
+                       "parent registry excluded",
+        "setup": {
+            "keygen_s": round(keygen_s, 1),
+            "seed_s": round(seed_s, 1),
+        },
+        "knobs": {
+            "FSDKR_INGRESS_MAX_FRAME_MB": os.environ.get(
+                "FSDKR_INGRESS_MAX_FRAME_MB", "8"),
+            "FSDKR_INGRESS_INFLIGHT_MB": os.environ.get(
+                "FSDKR_INGRESS_INFLIGHT_MB", "32"),
+            "FSDKR_INGRESS_IDLE_S": os.environ.get(
+                "FSDKR_INGRESS_IDLE_S", "60"),
+            "FSDKR_INGRESS_PEER_RPS": os.environ.get(
+                "FSDKR_INGRESS_PEER_RPS", "0"),
+            "max_attempts": args.max_attempts,
+        },
+        "gates": {
+            "zero_lost_broadcasts": len(lost_sessions) == 0,
+            "zero_wrong_verdicts": len(wrong) == 0,
+            "zero_wedged": wedged == 0,
+            "fleet_quiesced": _alive_inflight() == 0,
+            "p99_within_bound": (
+                p99_by is not None and bound_s is not None
+                and p99_by <= bound_s
+            ) or not bystander_lat,
+            "kills_injected": kills_done >= min(3, args.kills),
+        },
+    }
+    sup.stop()
+
+    out = args.out or "bench_results/net_storm.json"
+    pathlib.Path(out).parent.mkdir(parents=True, exist_ok=True)
+    pathlib.Path(out).write_text(
+        json.dumps(report, indent=1, default=str) + "\n"
+    )
+    log(f"[net] outcomes {cls} | wrong {len(wrong)} | wedged {wedged} | "
+        f"lost {len(lost_sessions)} | bystander p99 {p99_by}s "
+        f"(bound {bound_s}s) | net {report['net_sessions_per_s']}/s vs "
+        f"in-process {baseline['sessions_per_s']}/s")
+    log(f"[net] report -> {out} (total wall {time.time() - t_start:.0f}s)")
+    print(json.dumps(report, default=str))
+    return 0 if all(report["gates"].values()) else 1
+
+
 def main():
     args = parse_args()
+    if args.net_client:
+        return run_net_client()
+    if args.net:
+        return run_net_storm(args)
     if args.crash_storm:
         return run_crash_storm(args)
     t_start = time.time()
