@@ -34,11 +34,14 @@ middlebox) is detectable and droppable. Request ops:
   is an answer).
 - ``ping`` / ``stats`` — liveness and the ingress counter snapshot.
 
-Responses: ``submitted`` / ``fetched`` / ``broadcast_ack`` /
-``terminal`` / ``rejected`` (admission shed — overload policy, bisect
-guard, or the per-peer rate limiter; carries ``retry_after_s``) /
-``redirect`` (this shard does not own the committee; carries the peer
-port map so the client re-dials) / ``pong`` / ``stats`` / ``error``.
+Responses: ``submitted`` / ``fetched`` / ``pending`` (the session is
+alive but its distribute has not finished — retry the fetch; NOT the
+same as ``unknown_session``, which means resubmit) / ``broadcast_ack``
+/ ``terminal`` / ``rejected`` (admission shed — overload policy,
+bisect guard, or the per-peer rate limiter; carries ``retry_after_s``)
+/ ``redirect`` (this shard does not own the committee; carries the
+peer port map so the client re-dials) / ``pong`` / ``stats`` /
+``error``.
 
 ## Robustness (the point, not a bolt-on)
 
@@ -200,9 +203,11 @@ class _Conn(asyncio.Protocol):
         srv.conns.discard(self)
         metrics.ingress_open_gauge().set(len(srv.conns))
         metrics.ingress_connections().inc(outcome=self.outcome)
-        srv._release(self, self.inflight)
-        self.inflight = 0
+        srv._release(self, self.inflight)  # zeroes conn.inflight too
         if not any(c.peer == self.peer for c in srv.conns):
+            # forget() only drops a refilled, debt-free bucket — a peer
+            # closed for hammering keeps its rate state, so a tight
+            # connect/hammer/reconnect loop buys no fresh burst
             srv.limiter.forget(self.peer)
 
     def pause_writing(self) -> None:
@@ -312,6 +317,8 @@ class _Conn(asyncio.Protocol):
                         "serving": srv.service.stats()}
             elif op == "wait":
                 resp = await self._await_terminal(obj)
+            elif op == "submit":
+                resp = await self._submit(obj)
             else:
                 resp = await srv.loop.run_in_executor(
                     srv.pool, srv._handle_blocking, op, obj
@@ -319,7 +326,6 @@ class _Conn(asyncio.Protocol):
         except FrameError as e:
             if not self.closed:
                 srv._release(self, nbytes)
-                self.inflight -= nbytes
             self.close("error", cause=e.cause)
             return
         except Exception as e:
@@ -336,43 +342,57 @@ class _Conn(asyncio.Protocol):
             # (both run on the loop thread, so the check cannot race)
             if not self.closed:
                 srv._release(self, nbytes)
-                self.inflight -= nbytes
 
-    async def _await_terminal(self, obj: dict) -> dict:
-        """Async wait: polls the session's terminal state in 100 ms
-        slices instead of parking an executor thread for the whole
-        timeout — 16 cheap long-timeout `wait` frames from one peer
-        must never starve every other connection's submit/broadcast
-        out of the bounded pool."""
-        svc = self.server.service
-        sid = int(obj.get("sid", -1))
-        timeout = min(600.0, float(obj.get("timeout", 30.0)))
-        deadline = time.monotonic() + timeout
+    async def _poll(
+        self, probe, sid: int, deadline: float, timeout_resp: dict
+    ) -> dict:
+        """Slice-poll a non-blocking service probe on the executor:
+        `probe(sid)` raising TimeoutError means "still running" (sleep
+        100 ms, retry until `deadline` or the connection dies — the
+        timeout is a TYPED answer, never a closed connection) and
+        KeyError means the session is unknown. Polling instead of
+        parking keeps the bounded pool free: neither a burst of cheap
+        long-timeout `wait` frames nor a submit burst against a
+        backlogged service may starve the broadcast/fetch ops other
+        sessions need to reach quorum before their deadline."""
+        srv = self.server
         while True:
             try:
-                sess = await self.server.loop.run_in_executor(
-                    self.server.pool, svc.wait, sid, 0
-                )
+                return await srv.loop.run_in_executor(srv.pool, probe, sid)
             except TimeoutError:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or self.closed:
-                    # the satellite contract: a wait timeout is a TYPED
-                    # answer, never a closed connection
-                    return {"type": "error", "error": "timeout",
-                            "sid": sid, "timeout_s": timeout}
+                    return timeout_resp
                 await asyncio.sleep(min(0.1, remaining))
-                continue
             except KeyError:
                 return {"type": "error", "error": "unknown_session",
                         "sid": sid}
-            return {
-                "type": "terminal", "sid": sid, "state": sess.state,
-                "blame": sess.blame, "error": sess.error,
-                "retries": sess.retries,
-                "latency_s": round(
-                    max(0.0, sess.finalized_at - sess.submitted_at), 4
-                ),
-            }
+
+    async def _await_terminal(self, obj: dict) -> dict:
+        sid = int(obj.get("sid", -1))
+        timeout = min(600.0, float(obj.get("timeout", 30.0)))
+        return await self._poll(
+            self.server._wait_result, sid,
+            deadline=time.monotonic() + timeout,
+            timeout_resp={"type": "error", "error": "timeout",
+                          "sid": sid, "timeout_s": timeout},
+        )
+
+    async def _submit(self, obj: dict) -> dict:
+        """Admission runs one fast executor hop; the distribute wait
+        then slice-polls via `_poll`."""
+        srv = self.server
+        pre = await srv.loop.run_in_executor(srv.pool, srv._submit_admit, obj)
+        if isinstance(pre, dict):
+            return pre
+        sid = pre
+        bound = srv.service.deadline_s + 10.0
+        return await self._poll(
+            srv._submit_result, sid,
+            deadline=time.monotonic() + bound,
+            timeout_resp={"type": "error", "error": "timeout",
+                          "sid": sid, "timeout_s": round(bound, 3)},
+        )
 
     async def _respond(self, resp: dict, seq: int) -> None:
         if self.closed:
@@ -404,9 +424,11 @@ class IngressServer:
 
     Owns a dedicated event-loop thread, so it composes with the
     service's thread-based scheduler and with the shard child process
-    (`serving.supervisor`). Blocking service calls (`submit`'s
-    distribute wait, `wait`'s verdict wait) run on a bounded executor;
-    the loop thread only frames, routes, and enforces hygiene.
+    (`serving.supervisor`). Short blocking service calls run on a
+    bounded executor; the two long waits (`submit`'s distribute,
+    `wait`'s verdict) poll in slices from coroutines so they can never
+    park a pool thread for their full duration — the loop thread only
+    frames, routes, and enforces hygiene.
 
     `router(cid)` — optional: return a redirect payload (dict) when
     this shard does not own `cid`, or None to serve locally. The
@@ -482,6 +504,13 @@ class IngressServer:
                     metrics.ingress_paused().inc(scope="server")
 
     def _release(self, conn: _Conn, nbytes: int) -> None:
+        # the connection's own charge comes off FIRST: the resume
+        # checks below must see the post-release value, or the final
+        # release of a paused connection (e.g. one frame bigger than
+        # half the conn budget) reads its own stale charge, never
+        # resumes reading, and the connection is wedged forever — the
+        # hygiene sweep deliberately spares paused conns
+        conn.inflight = max(0, conn.inflight - nbytes)
         self.inflight = max(0, self.inflight - nbytes)
         if self.inflight <= self.inflight_budget // 2:
             for c in list(self.conns):
@@ -502,53 +531,79 @@ class IngressServer:
             conn.transport.resume_reading()
 
     # -- blocking op handlers (executor threads) ------------------------
+    def _submit_admit(self, obj: dict):
+        """submit, phase 1 (executor, fast): route + admit + enqueue.
+        Returns a final response dict (redirect/rejected/error) or the
+        new session id for the async distribute poll."""
+        svc = self.service
+        cid = obj.get("cid")
+        if cid is None:
+            raise FrameError("bad_op", "submit without cid")
+        if not svc.has_committee(cid):
+            if self.router is not None:
+                red = self.router(cid)
+                if red is not None:
+                    return dict(red, type="redirect")
+            return {"type": "error", "error": "unknown_committee",
+                    "cid": cid}
+        try:
+            return svc.submit(cid, epoch=obj.get("epoch"), external=True)
+        except ServeRejected as e:
+            return {
+                "type": "rejected", "reason": e.reason,
+                "retry_after_s": round(e.retry_after_s, 3),
+            }
+
+    def _submit_result(self, sid: int) -> dict:
+        """submit, phase 2 (executor, one poll slice): non-blocking
+        look at the distribute outputs; raises TimeoutError while they
+        are still pending (the coroutine sleeps and retries)."""
+        svc = self.service
+        state, wires = svc.wait_broadcasts(sid, timeout=0)
+        resp = {"type": "submitted", "sid": sid, "state": state}
+        if state in TERMINAL:
+            sess = svc.wait(sid, 0)
+            resp.update(blame=sess.blame, error=sess.error)
+        else:
+            senders = [snd for snd, _w in wires]
+            resp["senders"] = senders
+            total = sum(len(w) for _s, w in wires)
+            if total <= self.max_frame // 2:
+                resp["broadcasts"] = wires
+            # else: the client pulls per-sender `fetch` frames — a
+            # full-width committee's broadcast set must not demand a
+            # giant frame the cap exists to forbid
+        return resp
+
+    def _wait_result(self, sid: int) -> dict:
+        """wait, one poll slice (executor): non-blocking look at the
+        terminal verdict; raises TimeoutError while the session runs."""
+        sess = self.service.wait(sid, 0)
+        return {
+            "type": "terminal", "sid": sid, "state": sess.state,
+            "blame": sess.blame, "error": sess.error,
+            "retries": sess.retries,
+            "latency_s": round(
+                max(0.0, sess.finalized_at - sess.submitted_at), 4
+            ),
+        }
+
     def _handle_blocking(self, op: str, obj: dict) -> dict:
         svc = self.service
-        if op == "submit":
-            cid = obj.get("cid")
-            if cid is None:
-                raise FrameError("bad_op", "submit without cid")
-            if not svc.has_committee(cid):
-                if self.router is not None:
-                    red = self.router(cid)
-                    if red is not None:
-                        return dict(red, type="redirect")
-                return {"type": "error", "error": "unknown_committee",
-                        "cid": cid}
-            try:
-                sid = svc.submit(cid, epoch=obj.get("epoch"), external=True)
-            except ServeRejected as e:
-                return {
-                    "type": "rejected", "reason": e.reason,
-                    "retry_after_s": round(e.retry_after_s, 3),
-                }
-            # distribute runs on a service worker; bound the wait by the
-            # session's own deadline (after which the state is terminal)
-            state, wires = svc.wait_broadcasts(
-                sid, timeout=svc.deadline_s + 10.0
-            )
-            resp = {"type": "submitted", "sid": sid, "state": state}
-            if state in TERMINAL:
-                sess = svc.wait(sid, 0)
-                resp.update(blame=sess.blame, error=sess.error)
-            else:
-                senders = [snd for snd, _w in wires]
-                resp["senders"] = senders
-                total = sum(len(w) for _s, w in wires)
-                if total <= self.max_frame // 2:
-                    resp["broadcasts"] = wires
-                # else: the client pulls per-sender `fetch` frames — a
-                # full-width committee's broadcast set must not demand a
-                # giant frame the cap exists to forbid
-            return resp
         if op == "fetch":
             sid = int(obj.get("sid", -1))
             want = obj.get("senders")
             try:
                 state, wires = svc.wait_broadcasts(sid, timeout=0)
-            except (KeyError, TimeoutError):
+            except KeyError:
                 return {"type": "error", "error": "unknown_session",
                         "sid": sid}
+            except TimeoutError:
+                # the session EXISTS — distribute just hasn't finished.
+                # Answering 'unknown' here would tell the client its
+                # session died with a shard and push it into a
+                # pointless resubmit; 'pending' says retry the fetch.
+                return {"type": "pending", "sid": sid}
             if want is not None:
                 want = {int(s) for s in want}
                 wires = [(s, w) for s, w in wires if s in want]
@@ -628,8 +683,18 @@ class IngressServer:
                     # kernel by our own choice — aborting it as idle/
                     # slow-read would turn 'paused, not loss' into
                     # loss. (slow_write above still applies: that is
-                    # the PEER not reading us.)
-                    pass
+                    # the PEER not reading us.) But a conn paused by
+                    # the GLOBAL pass while holding little or no
+                    # charge of its own may have no release left to
+                    # resume it — if global inflight oscillates in
+                    # (budget/2, budget] the release-side checks never
+                    # fire for it, so the sweep is its resume backstop
+                    if (
+                        self.inflight <= self.inflight_budget
+                        and c.inflight <= self.conn_inflight_budget // 2
+                    ):
+                        c.paused = False
+                        c.transport.resume_reading()
                 elif (
                     self.idle_s > 0
                     and c.partial_since is not None
@@ -723,10 +788,14 @@ class IngressClient:
         self._buf = bytearray()
         # responses parsed while waiting for a different rid (client
         # pipelining: server answers in COMPLETION order, not request
-        # order) — never dropped, handed back when their recv() comes;
-        # rids already handed back, so a net_dup duplicate is discarded
+        # order) — handed back when their recv() comes; rids already
+        # handed back, so a net_dup duplicate is discarded. A rid
+        # whose recv() TIMED OUT forfeits its response: the documented
+        # recovery for a timeout is reconnect + idempotent resubmit,
+        # never a re-recv of the same rid
         self._pending: dict = {}
         self._done_rids: set = set()
+        self._outstanding: set = set()  # rids sent, not yet handed back
         self._sock = socket.create_connection((host, port), timeout=timeout)
 
     # -- framing --------------------------------------------------------
@@ -738,7 +807,24 @@ class IngressClient:
             self._sock.sendall(encode_frame(obj))
         except OSError as e:
             raise ConnectionError(f"send failed: {e}") from None
+        # only after the frame is on the wire: a failed send must not
+        # leave a rid outstanding forever, pinning the prune floor
+        self._outstanding.add(self._rid)
         return self._rid
+
+    def _done(self, rid: int) -> None:
+        """Record `rid` as handed back and bound the dup-tracking
+        state: anything below the OLDEST rid still awaiting its recv
+        can only ever be a duplicate — a long-lived client under
+        dup-heavy chaos must not leak `_done_rids`/`_pending`, but a
+        parked response a pipelining caller has yet to collect must
+        survive the prune."""
+        self._outstanding.discard(rid)
+        self._done_rids.add(rid)
+        floor = min(self._outstanding, default=self._rid)
+        self._done_rids = {r for r in self._done_rids if r >= floor}
+        for r in [r for r in self._pending if r < floor]:
+            del self._pending[r]
 
     def recv(self, rid: Optional[int] = None, timeout: Optional[float] = None) -> dict:
         """Read frames until one matches `rid` (default: the last
@@ -749,27 +835,32 @@ class IngressClient:
         )
         while True:
             if want in self._pending:
-                self._done_rids.add(want)
-                return self._pending.pop(want)
+                resp = self._pending.pop(want)  # pop BEFORE the prune
+                self._done(want)
+                return resp
             got = None
             for obj, _n in _parse_frames(self._buf, self.max_frame):
                 r = obj.get("rid")
-                if got is None and (r == want or r is None):
-                    got = obj
-                elif (
-                    r is not None
-                    and r not in self._pending
-                    and r not in self._done_rids
-                ):
+                if r == want or r is None:
+                    # a net_dup duplicate of the awaited rid in the
+                    # SAME parse batch is discarded here, never parked
+                    if got is None:
+                        got = obj
+                elif r not in self._pending and r not in self._done_rids:
                     # an out-of-order pipelined response: park it; a
                     # DUPLICATE (net_dup) of one already parked or
                     # already handed back is discarded
                     self._pending[r] = obj
             if got is not None:
-                self._done_rids.add(want)
+                self._done(want)
                 return got
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                # the caller is giving up on this rid: it must not pin
+                # the prune floor for the rest of the client's life
+                # (which also means a late response for it may be
+                # pruned — a timed-out rid forfeits its response)
+                self._outstanding.discard(want)
                 raise ConnectionError(f"no response for rid {want} in time")
             self._sock.settimeout(min(remaining, 5.0))
             try:

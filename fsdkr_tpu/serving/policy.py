@@ -238,8 +238,13 @@ class PeerRateLimiter:
     rps 0 disables the limiter. The bucket holds at most ``burst``
     (default 2x rps) tokens, so a quiet peer can absorb a small spike;
     debt beyond another burst of rejected requests is the
-    close-the-connection threshold. State is O(active peers) and
-    dropped via `forget()` when a peer's last connection closes."""
+    close-the-connection threshold. State stays O(recently active
+    peers): `forget()` (a peer's last connection closed) drops only a
+    bucket already refilled to a full burst — a spent or indebted
+    bucket is RETAINED, so a hostile peer cannot reset the limiter
+    with a tight connect/hammer/reconnect loop — and `charge()`
+    lazily prunes retained buckets once they refill (at which point a
+    fresh bucket would be no more permissive anyway)."""
 
     def __init__(self, rps: Optional[float] = None, burst: Optional[float] = None):
         self.rps = (
@@ -249,6 +254,7 @@ class PeerRateLimiter:
         self._lock = threading.Lock()
         # peer -> [tokens, last_refill_monotonic, consecutive_sheds]
         self._buckets: Dict[object, list] = {}
+        self._ops = 0
 
     def enabled(self) -> bool:
         return self.rps > 0
@@ -258,6 +264,9 @@ class PeerRateLimiter:
             return None
         now = time.monotonic() if now is None else now
         with self._lock:
+            self._ops += 1
+            if self._ops % 512 == 0:
+                self._prune_locked(now)
             b = self._buckets.get(peer)
             if b is None:
                 b = self._buckets[peer] = [self.burst, now, 0]
@@ -273,6 +282,27 @@ class PeerRateLimiter:
                 return -1.0
             return max(0.05, (1.0 - tokens) / self.rps)
 
-    def forget(self, peer) -> None:
+    def _refilled(self, b: list, now: float) -> bool:
+        # THE droppability invariant: refilled to a full burst, the
+        # bucket is behaviorally identical to a fresh one (the next
+        # admit resets any shed debt anyway)
+        return b[0] + (now - b[1]) * self.rps >= self.burst
+
+    def _prune_locked(self, now: float) -> None:
+        dead = [p for p, b in self._buckets.items() if self._refilled(b, now)]
+        for p in dead:
+            del self._buckets[p]
+
+    def forget(self, peer, now: Optional[float] = None) -> None:
+        """A peer's last connection closed. Drop its bucket ONLY if it
+        has refilled to a full burst — behaviorally identical to a
+        fresh one. A spent or indebted bucket is retained (an instant
+        reconnect must not buy a fresh burst); `charge()`'s lazy prune
+        reclaims it once burst/rps quiet seconds have passed."""
+        if not self.enabled():
+            return
+        now = time.monotonic() if now is None else now
         with self._lock:
-            self._buckets.pop(peer, None)
+            b = self._buckets.get(peer)
+            if b is not None and self._refilled(b, now):
+                del self._buckets[peer]

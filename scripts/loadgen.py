@@ -434,6 +434,26 @@ def run_net_client():
                 bcasts = r.get("broadcasts")
                 if bcasts is None:
                     f = cli.fetch(sid, timeout=op_timeout)
+                    while f.get("type") in ("pending", "rejected") \
+                            and time.monotonic() < budget:
+                        # pending: the session is ALIVE, distribute
+                        # just hasn't finished; rejected: the limiter
+                        # shed this re-fetch — either way retry the
+                        # fetch (honoring retry_after_s so the limiter
+                        # isn't hammered into the close verdict);
+                        # resubmitting would burn attempts on a live
+                        # session
+                        if f.get("type") == "rejected":
+                            count("rejected")
+                        time.sleep(max(
+                            0.1, float(f.get("retry_after_s", 0.0))
+                        ))
+                        f = cli.fetch(sid, timeout=op_timeout)
+                    if f.get("type") in ("pending", "rejected"):
+                        continue  # wall budget expired first: no
+                        # broadcasts were delivered, so waiting for a
+                        # verdict is futile — let the outer budget
+                        # guard end the epoch
                     bcasts = f.get("broadcasts") or []
                 rng.shuffle(bcasts)  # arrival order must not matter
                 resubmit = False

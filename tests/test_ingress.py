@@ -125,10 +125,17 @@ def test_peer_rate_limiter_unit():
     assert verdicts[-1] == -1.0
     # an independent peer is untouched
     assert lim.charge("b", t) is None
-    # tokens refill with time; forget() resets the debt
+    # tokens refill with time (and a successful admit clears the debt)
     assert lim.charge("a", t + 10.0) is None
-    lim.forget("a")
-    assert lim.charge("a", t) is None
+    assert lim.charge("a", t + 10.0) is None  # bucket now spent again
+    # disconnect while spent: the bucket is RETAINED — an instant
+    # reconnect must not buy a hammering peer a fresh burst
+    lim.forget("a", t + 10.0)
+    assert lim.charge("a", t + 10.0) is not None
+    # disconnect after the bucket refilled to a full burst: dropped
+    # (a fresh bucket would be no more permissive)
+    lim.forget("a", t + 20.0)
+    assert lim.charge("a", t + 20.0) is None
     assert PeerRateLimiter(rps=0).charge("x") is None  # disabled
 
 
@@ -448,6 +455,76 @@ def test_backpressure_pauses_reads_under_inflight_budget(test_config):
         svc.stop()
 
 
+def test_big_frame_release_resumes_reads(test_config):
+    """REGRESSION: a single frame larger than half the per-connection
+    budget pauses reads; its OWN release must resume them. (The bug:
+    _release ran before the connection's charge was decremented, so
+    the resume check saw the stale value and the connection wedged
+    forever — the hygiene sweep deliberately spares paused conns.)"""
+    keys = simulate_keygen(1, 3, test_config)
+    svc = RefreshService(deadline_s=30.0)
+    svc.admit("big", [k.clone() for k in keys], test_config)
+    svc.start()
+    srv = IngressServer(svc, conn_inflight_budget=256).start()
+    cli = None
+    try:
+        cli = IngressClient("127.0.0.1", srv.port)
+        # one ~600 B frame: charges past the 256 B budget alone, so its
+        # release is the ONLY event that can ever resume this conn
+        r = cli.request({"op": "ping", "pad": "x" * 600}, timeout=10)
+        assert r["type"] == "pong", r
+        # reads resumed: the next request on the same conn is answered
+        assert cli.ping()["type"] == "pong"
+        paused = smetrics.ingress_snapshot()["paused_reads"]
+        assert paused.get("conn", 0) >= 1, paused
+    finally:
+        if cli is not None:
+            cli.close()
+        srv.stop()
+        svc.stop()
+
+
+def test_sweep_resumes_server_paused_idle_conn(test_config):
+    """REGRESSION: a connection paused by the GLOBAL budget pass while
+    holding no charge of its own has no release of its own to resume
+    it, and while global inflight oscillates in (budget/2, budget] the
+    release-side resume checks never fire — the hygiene sweep must be
+    its resume backstop (it deliberately never closes paused conns)."""
+    keys = simulate_keygen(1, 3, test_config)
+    svc = RefreshService(deadline_s=20.0)
+    svc.admit("sw", [k.clone() for k in keys], test_config)
+    svc.start()
+    srv = IngressServer(svc).start()
+    cli = None
+    try:
+        cli = IngressClient("127.0.0.1", srv.port, timeout=10)
+        assert cli.ping()["type"] == "pong"
+        conn = next(iter(srv.conns))
+        paused = threading.Event()
+
+        def _pause():
+            # what the global pass does to an idle bystander, with the
+            # load band then held above budget/2 by OTHER connections
+            srv.inflight = srv.inflight_budget // 2 + 1
+            conn.paused = True
+            conn.transport.pause_reading()
+            paused.set()
+
+        srv.loop.call_soon_threadsafe(_pause)
+        assert paused.wait(5)
+        deadline = time.monotonic() + 5.0
+        while conn.paused and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert not conn.paused, "sweep never resumed the idle paused conn"
+        srv.loop.call_soon_threadsafe(setattr, srv, "inflight", 0)
+        assert cli.ping()["type"] == "pong"  # reads really did resume
+    finally:
+        if cli is not None:
+            cli.close()
+        srv.stop()
+        svc.stop()
+
+
 def test_slow_read_loris_closed_despite_drip(test_config):
     """A peer dribbling one byte of a never-completed frame keeps the
     idle clock fresh — but no single frame gets longer than idle_s to
@@ -591,6 +668,44 @@ def test_net_dup_responses_deduped_by_rid(test_config):
         faults.reset()
         srv.stop()
         svc.stop()
+
+
+def test_client_same_batch_dup_not_parked_and_state_bounded():
+    """REGRESSION: a net_dup duplicate of the awaited rid landing in
+    the SAME parse batch must be discarded, not parked forever in
+    `_pending`; and `_done_rids` must stay bounded on a long-lived
+    client."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    cli = IngressClient("127.0.0.1", lsock.getsockname()[1], timeout=1)
+    try:
+        # both copies of rid 1's response sit in the buffer before recv
+        cli._rid = 1
+        frame = encode_frame({"type": "pong", "rid": 1})
+        cli._buf += frame + frame
+        assert cli.recv(1, timeout=1)["type"] == "pong"
+        assert not cli._pending, cli._pending  # dup discarded, not parked
+        # dup-tracking state is pruned up to the oldest rid still
+        # awaiting its recv (here: none outstanding)
+        cli._done_rids.update(range(1, 5000))
+        cli._pending.update({r: {} for r in range(2, 50)})
+        cli._rid = 5000
+        cli._buf += encode_frame({"type": "pong", "rid": 5000})
+        assert cli.recv(5000, timeout=1)["type"] == "pong"
+        assert len(cli._done_rids) == 1, cli._done_rids
+        assert not cli._pending, cli._pending
+        # a parked response whose rid is STILL outstanding survives the
+        # prune and is handed back — pop runs before the prune, so this
+        # neither KeyErrors nor discards a response the caller awaits
+        cli._rid = 9000
+        cli._outstanding.add(20)
+        cli._pending[20] = {"type": "pong", "rid": 20}
+        assert cli.recv(20, timeout=1)["type"] == "pong"
+        assert 20 not in cli._pending and not cli._outstanding
+    finally:
+        cli.close()
+        lsock.close()
 
 
 def test_redirect_for_unowned_committee(test_config):
