@@ -1,0 +1,92 @@
+"""fsdkr-lint: AST-based static analysis of the fs-dkr-tpu tree.
+
+Four passes over the whole package (driver: ``scripts/fsdkr_lint.py``,
+gating ci.sh):
+
+- ``taint``   — secret-flow: SECURITY.md's secret carriers must not
+  reach journal/wire/telemetry/LRU/log/JSON sinks unsanitized.
+- ``locks``   — lock discipline: static lock-order graph (cycles) and
+  blocking calls inside ``with <lock>:`` bodies.
+- ``knobs``   — knob drift: every FSDKR_* env read declared in
+  `fsdkr_tpu.knobs.KNOBS` + README-documented; no dead knobs; no
+  loop-body env reads.
+- ``imports`` — unused imports + package layering (the former
+  scripts/lint_imports.py).
+
+The package deliberately imports nothing from the rest of fsdkr_tpu
+except (lazily, in `lockwatch`) the telemetry flight recorder, so
+linting never loads jax or the engines — enforced by its own layering
+rule. `lockwatch` is the runtime counterpart: a FSDKR_LOCK_CHECK=1
+lock-order watchdog that validates the static graph during tier-1.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Dict, Iterable, List, Optional
+
+from . import imports, knobs, locks, taint
+from .common import Finding, SourceFile, build_index, load_files
+
+__all__ = [
+    "Finding",
+    "PASSES",
+    "run_passes",
+    "load_files",
+]
+
+# name -> (module, needs_repo_root)
+PASSES = {
+    "taint": taint,
+    "locks": locks,
+    "knobs": knobs,
+    "imports": imports,
+}
+
+
+def run_passes(
+    paths: Iterable[str],
+    which: Optional[Iterable[str]] = None,
+    repo_root: Optional[str] = None,
+    registry_checks: bool = True,
+) -> Dict[str, object]:
+    """Run the selected passes (default: all) over `paths`. Returns
+    ``{"findings": [...], "suppressed": int, "files": int}`` with
+    suppressions already applied and suppression-syntax findings
+    included. ``registry_checks=False`` disables the knob pass's
+    registry-wide dead/undocumented reconciliation — required when
+    `paths` is a subset of the tree (the read surface is incomplete)."""
+    root = pathlib.Path(repo_root or ".").resolve()
+    files = load_files(paths, root=str(root))
+    index = build_index(files)
+    selected = list(which) if which else list(PASSES)
+    raw: List[Finding] = []
+    for name in selected:
+        if name not in PASSES:
+            raise ValueError(
+                f"unknown pass {name!r} (have: {', '.join(PASSES)})"
+            )
+        mod = PASSES[name]
+        if name == "knobs":
+            raw += mod.run(files, index, repo_root=root,
+                           registry_checks=registry_checks)
+        else:
+            raw += mod.run(files, index)
+
+    by_rel = {sf.rel: sf for sf in files}
+    findings: List[Finding] = []
+    suppressed = 0
+    for f in raw:
+        sf = by_rel.get(f.path)
+        if sf is not None and sf.suppressed(f.line, f.rule):
+            suppressed += 1
+            continue
+        findings.append(f)
+    for sf in files:
+        findings += sf.suppression_findings()
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return {
+        "findings": findings,
+        "suppressed": suppressed,
+        "files": len(files),
+    }

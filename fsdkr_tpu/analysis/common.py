@@ -1,0 +1,269 @@
+"""Shared infrastructure of the fsdkr-lint static-analysis framework.
+
+Every pass (`taint`, `locks`, `knobs`, `imports`) consumes the same
+parsed view of the tree — a list of :class:`SourceFile` (source text +
+AST + parsed inline suppressions) plus a :class:`ProjectIndex` of
+classes, their methods, and cheap receiver-type facts used by the lock
+and taint passes to resolve ``self._journal.append(...)``-style calls.
+
+Suppressions are in-code and auditable::
+
+    something_flagged()  # fsdkr-lint: allow(lock-blocking-call) reason
+
+A suppression covers findings of the named rule(s) on its own line or,
+when the comment stands alone, on the next line. A suppression without
+a reason is itself a finding (``suppression-missing-reason``): the
+point of the mechanism is that known residuals stay *documented*.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set
+
+__all__ = [
+    "Finding",
+    "SourceFile",
+    "ProjectIndex",
+    "load_files",
+    "build_index",
+    "dotted_name",
+    "iter_functions",
+]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*fsdkr-lint:\s*allow\(([a-z0-9_,\- ]+)\)\s*(.*)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class SourceFile:
+    """One parsed source file: text, AST, and suppression map."""
+
+    def __init__(self, path: pathlib.Path, root: pathlib.Path):
+        self.path = path
+        self.rel = path.relative_to(root).as_posix() if root in path.parents \
+            or path == root else path.as_posix()
+        self.text = path.read_text()
+        self.tree = ast.parse(self.text, filename=str(path))
+        self.module = self._module_name()
+        # line -> set of allowed rules ("*" = all); parallel reason map
+        self.suppressions: Dict[int, Set[str]] = {}
+        self.suppression_reasons: Dict[int, str] = {}
+        self._parse_suppressions()
+
+    def _module_name(self) -> str:
+        parts = self.path.with_suffix("").parts
+        if "fsdkr_tpu" in parts:
+            i = parts.index("fsdkr_tpu")
+            mod = parts[i:]
+            if mod[-1] == "__init__":
+                mod = mod[:-1]
+            return ".".join(mod)
+        return self.path.stem
+
+    def _parse_suppressions(self) -> None:
+        lines = self.text.splitlines()
+        for i, raw in enumerate(lines, start=1):
+            m = _SUPPRESS_RE.search(raw)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = m.group(2).strip()
+            # comment-only line covers the NEXT line; trailing comment
+            # covers its own line
+            target = i + 1 if raw.lstrip().startswith("#") else i
+            self.suppressions.setdefault(target, set()).update(rules)
+            if reason:
+                self.suppression_reasons[target] = reason
+            else:
+                self.suppression_reasons.setdefault(target, "")
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        allowed = self.suppressions.get(line)
+        return bool(allowed) and (rule in allowed or "*" in allowed)
+
+    def suppression_findings(self) -> List[Finding]:
+        out = []
+        for line, reason in sorted(self.suppression_reasons.items()):
+            if not reason:
+                out.append(Finding(
+                    self.rel, line, "suppression-missing-reason",
+                    "fsdkr-lint: allow(...) must carry a reason — "
+                    "suppressions document residuals, not hide them",
+                ))
+        return out
+
+
+def load_files(paths: Iterable[str], root: Optional[str] = None
+               ) -> List[SourceFile]:
+    rootp = pathlib.Path(root or ".").resolve()
+    out: List[SourceFile] = []
+    for p in paths:
+        pp = pathlib.Path(p)
+        if not pp.exists():
+            raise FileNotFoundError(
+                f"fsdkr-lint: no such path: {p} (a renamed root must fail "
+                "the gate, not shrink its coverage)"
+            )
+        files = [pp] if pp.is_file() else sorted(pp.rglob("*.py"))
+        for f in files:
+            if "__pycache__" in f.parts:
+                continue
+            out.append(SourceFile(f.resolve(), rootp))
+    return out
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, 'f().g' collapses the call:
+    Call nodes contribute their func's dotted name + '()'."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else None
+    if isinstance(node, ast.Call):
+        base = dotted_name(node.func)
+        return f"{base}()" if base else None
+    return None
+
+
+def iter_functions(tree: ast.Module):
+    """Yield (qualname, class_name_or_None, funcdef) for every function
+    and method, including nested ones (qualname carries the nesting)."""
+
+    def walk(node, prefix: str, cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                yield q, cls, child
+                yield from walk(child, q + ".", cls)
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{child.name}.", child.name)
+
+    yield from walk(tree, "", None)
+
+
+# ---------------------------------------------------------------------------
+# project index: classes, methods, and receiver-type facts
+
+
+@dataclass
+class ClassInfo:
+    module: str
+    name: str
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+
+    @property
+    def qual(self) -> str:
+        return f"{self.module}.{self.name}"
+
+
+class ProjectIndex:
+    """Cross-file facts the passes share.
+
+    - ``classes``: ClassName -> ClassInfo (last definition wins on the
+      rare duplicate; passes that care disambiguate by module).
+    - ``attr_types``: attribute/variable name -> class name, built from
+      every ``x = ClassName(...)`` / ``self.x = ClassName(...)`` /
+      ``x: ClassName`` in the project where the name->class mapping is
+      UNIQUE project-wide. This is deliberately name-based: the codebase
+      names instances after their class (``self._journal = Journal(...)``)
+      and the passes only need "which class might this receiver be".
+    """
+
+    def __init__(self) -> None:
+        self.classes: Dict[str, ClassInfo] = {}
+        self.attr_types: Dict[str, str] = {}
+        self._attr_candidates: Dict[str, Set[str]] = {}
+
+    def note_binding(self, attr: str, cls: str) -> None:
+        self._attr_candidates.setdefault(attr, set()).add(cls)
+
+    def finalize(self) -> None:
+        for attr, cands in self._attr_candidates.items():
+            if len(cands) == 1:
+                self.attr_types[attr] = next(iter(cands))
+
+    def receiver_class(self, recv: str) -> Optional[str]:
+        """Best-effort class of a receiver's last component: explicit
+        binding first, then the instance-named-after-class convention
+        (``_journal`` -> Journal)."""
+        last = recv.split(".")[-1].rstrip("()")
+        if last in self.attr_types:
+            return self.attr_types[last]
+        norm = last.lstrip("_").replace("_", "").lower()
+        for cname in self.classes:
+            if cname.lower() == norm:
+                return cname
+        return None
+
+
+def _annotation_class(node: Optional[ast.AST]) -> Optional[str]:
+    """Unwrap Optional[...]/'quoted' annotations down to a bare Name."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(node, ast.Subscript):
+        return _annotation_class(node.slice)
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def build_index(files: List[SourceFile]) -> ProjectIndex:
+    idx = ProjectIndex()
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                info = ClassInfo(sf.module, node.name)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        info.methods[item.name] = item
+                idx.classes[node.name] = info
+    class_names = set(idx.classes)
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                cls = dotted_name(node.value.func)
+                cls = cls.split(".")[-1] if cls else None
+                if cls in class_names:
+                    for t in node.targets:
+                        name = dotted_name(t)
+                        if name:
+                            idx.note_binding(name.split(".")[-1], cls)
+            elif isinstance(node, ast.AnnAssign):
+                cls = _annotation_class(node.annotation)
+                if cls in class_names:
+                    name = dotted_name(node.target)
+                    if name:
+                        idx.note_binding(name.split(".")[-1], cls)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for arg in (list(node.args.posonlyargs) + list(node.args.args)
+                            + list(node.args.kwonlyargs)):
+                    cls = _annotation_class(arg.annotation)
+                    if cls in class_names:
+                        idx.note_binding(arg.arg, cls)
+    idx.finalize()
+    return idx

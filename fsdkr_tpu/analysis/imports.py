@@ -1,0 +1,172 @@
+"""Import hygiene + layering pass (the former scripts/lint_imports.py).
+
+Two rules:
+
+- ``unused-import``: flags imports never referenced. Conservative by
+  design — ``__all__`` entries, re-export modules (``__init__.py``),
+  names starting with ``_``, and names referenced from quoted string
+  annotations are exempt.
+- ``layering``: `fsdkr_tpu/serving` is an orchestration layer and must
+  reach the cryptography only through the protocol surface — importing
+  ``proofs``, ``backend``, ``ops``, ``native``, or ``core`` internals
+  from serving (absolute or relative) is a finding. Same for the new
+  ``fsdkr_tpu/analysis`` package, which must stay importable without
+  jax: it may import nothing from the package except ``telemetry`` (the
+  flight recorder, for the runtime watchdog) — keeping the linter free
+  of the engines it lints.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import List
+
+from .common import Finding, SourceFile
+
+__all__ = ["run", "RULES"]
+
+RULES = ("unused-import", "layering")
+
+# package-dir -> module prefixes its files must not import. Checked for
+# every *.py under the directory, __init__.py included.
+LAYERING_RULES = {
+    "fsdkr_tpu/serving": (
+        "fsdkr_tpu.proofs",
+        "fsdkr_tpu.backend",
+        "fsdkr_tpu.ops",
+        "fsdkr_tpu.native",
+        "fsdkr_tpu.core",
+    ),
+    "fsdkr_tpu/analysis": (
+        # everything except telemetry (flight recorder, for lockwatch):
+        # the linter must stay loadable without jax or the engines
+        "fsdkr_tpu.proofs",
+        "fsdkr_tpu.backend",
+        "fsdkr_tpu.ops",
+        "fsdkr_tpu.native",
+        "fsdkr_tpu.core",
+        "fsdkr_tpu.protocol",
+        "fsdkr_tpu.serving",
+        "fsdkr_tpu.precompute",
+        "fsdkr_tpu.parallel",
+        "fsdkr_tpu.utils",
+    ),
+}
+
+
+def _abs_module(node: ast.ImportFrom, path: pathlib.Path) -> str:
+    """Absolute dotted module of an ImportFrom, resolving relative
+    imports against the file's package (CPython semantics: __package__
+    is the containing package for BOTH regular modules and __init__.py,
+    and level N strips N-1 trailing components from it)."""
+    if node.level == 0:
+        return node.module or ""
+    parts = path.resolve().parts
+    try:
+        root = parts.index("fsdkr_tpu")
+    except ValueError:
+        return node.module or ""
+    pkg = list(parts[root:-1])  # the module's package path
+    base = pkg[: len(pkg) - (node.level - 1)] if node.level > 1 else pkg
+    return ".".join(base + ([node.module] if node.module else []))
+
+
+def _check_layering(sf: SourceFile) -> List[Finding]:
+    rel = sf.rel
+    rules = [
+        (prefix, banned)
+        for prefix, banned in LAYERING_RULES.items()
+        if f"/{prefix}/" in f"/{rel}" or rel.startswith(prefix + "/")
+    ]
+    if not rules:
+        return []
+    findings = []
+    for node in ast.walk(sf.tree):
+        mods = []
+        if isinstance(node, ast.Import):
+            mods = [a.name for a in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            mods = [_abs_module(node, sf.path)]
+        for mod in mods:
+            for prefix, banned in rules:
+                layer = prefix.split("/")[-1]
+                for b in banned:
+                    if mod == b or mod.startswith(b + "."):
+                        findings.append(Finding(
+                            sf.rel, node.lineno, "layering",
+                            f"{layer} must not import {mod!r} "
+                            + ("(use the protocol surface)"
+                               if layer == "serving"
+                               else "(the linter must not import what "
+                                    "it lints)"),
+                        ))
+    return findings
+
+
+def _check_unused(sf: SourceFile) -> List[Finding]:
+    if sf.path.name == "__init__.py":
+        return []  # re-export wiring: imports are the point
+    tree = sf.tree
+    exported = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    try:
+                        exported = set(ast.literal_eval(node.value))
+                    except ValueError:
+                        pass
+
+    imported = {}  # name -> lineno
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = (a.asname or a.name).split(".")[0]
+                imported[name] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue  # compiler directives, not names
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                imported[a.asname or a.name] = node.lineno
+
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # quoted annotations ('-> "ProtocolConfig"', TYPE_CHECKING
+            # uses) reference names as strings: count their roots as used
+            try:
+                sub = ast.parse(node.value, mode="eval")
+            except SyntaxError:
+                continue
+            for n in ast.walk(sub):
+                if isinstance(n, ast.Name):
+                    used.add(n.id)
+        elif isinstance(node, ast.Attribute):
+            # record the root of dotted access: jax.numpy -> jax
+            n = node
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+
+    findings = []
+    for name, lineno in sorted(imported.items(), key=lambda kv: kv[1]):
+        if name in used or name in exported or name.startswith("_"):
+            continue
+        findings.append(Finding(
+            sf.rel, lineno, "unused-import", f"unused import {name!r}"
+        ))
+    return findings
+
+
+def run(files: List[SourceFile], index=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        findings += _check_layering(sf)
+        findings += _check_unused(sf)
+    return findings

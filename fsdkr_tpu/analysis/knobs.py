@@ -1,0 +1,174 @@
+"""Knob-drift pass: every ``FSDKR_*`` environment read must be declared
+in the central registry (`fsdkr_tpu/knobs.py`) and documented in the
+README knob table; declared knobs must actually be read somewhere.
+
+Rules:
+
+- ``knob-undeclared``: an env read of an ``FSDKR_*`` name with no row in
+  ``fsdkr_tpu.knobs.KNOBS``.
+- ``knob-undocumented``: a registry row with no ``FSDKR_*`` mention in
+  README.md (reported against knobs.py).
+- ``knob-dead``: a registry row no scanned file reads (reported against
+  knobs.py) — dead knobs in the README are how retuning instructions rot.
+- ``knob-hot-read``: an env read inside a ``for``/``while`` body — env
+  reads are cheap but not free, and a loop-body ``getenv`` is how a
+  per-row hot path ends up re-parsing configuration per call. Hoist it.
+
+Env reads are recognized syntactically: ``os.environ.get/[]``,
+``os.environ.setdefault``, ``os.getenv``, with a string literal first
+argument matching ``FSDKR_[A-Z0-9_]+``. The registry is read with
+``ast.literal_eval`` so the pass never imports the package.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .common import Finding, SourceFile, dotted_name
+
+__all__ = ["run", "RULES", "load_registry"]
+
+RULES = ("knob-undeclared", "knob-undocumented", "knob-dead",
+         "knob-hot-read")
+
+_KNOB_RE = re.compile(r"^FSDKR_[A-Z0-9_]+$")
+
+# env-read call heads: any alias of os.environ (`_os.environ.get`), the
+# getenv builtins, and the repo's `_env_*` literal-name helpers
+# (`_env_int`/`_env_float`/`_env_mb`/...)
+_ENV_GETTER_SUFFIXES = ("environ.get", "environ.setdefault",
+                        "environ.pop")
+_ENV_SUBSCRIPT_SUFFIX = "environ"
+
+
+def _is_env_getter(head: str) -> bool:
+    if head.endswith(_ENV_GETTER_SUFFIXES):
+        return True
+    last = head.split(".")[-1]
+    return last == "getenv" or last.startswith("_env")
+
+
+def load_registry(repo_root: pathlib.Path) -> Dict[str, str]:
+    """Parse KNOBS out of fsdkr_tpu/knobs.py without importing it."""
+    path = repo_root / "fsdkr_tpu" / "knobs.py"
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "KNOBS":
+                    knobs = ast.literal_eval(node.value)
+                    if not isinstance(knobs, dict):
+                        raise ValueError("KNOBS must be a dict literal")
+                    return knobs
+    raise ValueError("fsdkr_tpu/knobs.py: no KNOBS dict found")
+
+
+def _registry_lines(repo_root: pathlib.Path) -> Dict[str, int]:
+    path = repo_root / "fsdkr_tpu" / "knobs.py"
+    lines = {}
+    for i, raw in enumerate(path.read_text().splitlines(), start=1):
+        m = re.search(r'"(FSDKR_[A-Z0-9_]+)"\s*:', raw)
+        if m:
+            lines[m.group(1)] = i
+    return lines
+
+
+def _knob_read(node: ast.Call) -> Optional[str]:
+    # `env_var="FSDKR_X"` keywords mark deferred reads (NativeLib-style
+    # gates) no matter what the call is
+    for kw in node.keywords:
+        if kw.arg in ("env_var", "env", "knob") \
+                and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str) \
+                and _KNOB_RE.match(kw.value.value):
+            return kw.value.value
+    head = dotted_name(node.func)
+    if head is None or not _is_env_getter(head):
+        return None
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str) \
+            and _KNOB_RE.match(node.args[0].value):
+        return node.args[0].value
+    return None
+
+
+def _subscript_read(node: ast.Subscript) -> Optional[str]:
+    head = dotted_name(node.value)
+    if head is not None and head.endswith(_ENV_SUBSCRIPT_SUFFIX) \
+            and isinstance(node.slice, ast.Constant) \
+            and isinstance(node.slice.value, str) \
+            and _KNOB_RE.match(node.slice.value):
+        return node.slice.value
+    return None
+
+
+def collect_reads(files: List[SourceFile]
+                  ) -> List[Tuple[SourceFile, int, str, bool]]:
+    """Every (file, line, knob, in_loop) env-read site."""
+    out = []
+    for sf in files:
+        def visit(node, in_loop):
+            name = None
+            if isinstance(node, ast.Call):
+                name = _knob_read(node)
+            elif isinstance(node, ast.Subscript):
+                name = _subscript_read(node)
+            if name:
+                out.append((sf, node.lineno, name, in_loop))
+            enter_loop = isinstance(node, (ast.For, ast.While,
+                                           ast.AsyncFor))
+            for child in ast.iter_child_nodes(node):
+                visit(child, in_loop or enter_loop)
+
+        visit(sf.tree, False)
+    return out
+
+
+def run(files: List[SourceFile], index=None,
+        repo_root: Optional[pathlib.Path] = None,
+        registry_checks: bool = True) -> List[Finding]:
+    """Per-read rules (undeclared/hot) always run; the REGISTRY-WIDE
+    reconciliation (dead/undocumented) needs the full read surface, so
+    callers linting a path subset pass registry_checks=False (the
+    driver does this automatically for explicit path arguments) —
+    otherwise every knob the subset doesn't read would read as dead."""
+    repo_root = repo_root or pathlib.Path(".").resolve()
+    registry = load_registry(repo_root)
+    reg_lines = _registry_lines(repo_root)
+    readme = (repo_root / "README.md").read_text()
+    documented: Set[str] = set(re.findall(r"FSDKR_[A-Z0-9_]+", readme))
+
+    findings: List[Finding] = []
+    read_names: Set[str] = set()
+    for sf, line, name, in_loop in collect_reads(files):
+        read_names.add(name)
+        if name not in registry:
+            findings.append(Finding(
+                sf.rel, line, "knob-undeclared",
+                f"{name} read here but not declared in "
+                f"fsdkr_tpu/knobs.py KNOBS",
+            ))
+        if in_loop:
+            findings.append(Finding(
+                sf.rel, line, "knob-hot-read",
+                f"{name} read inside a loop body — hoist the env read "
+                "out of the hot path",
+            ))
+    if not registry_checks:
+        return findings
+    for name in sorted(registry):
+        line = reg_lines.get(name, 1)
+        if name not in documented:
+            findings.append(Finding(
+                "fsdkr_tpu/knobs.py", line, "knob-undocumented",
+                f"{name} declared but has no README.md knob-table row",
+            ))
+        if name not in read_names:
+            findings.append(Finding(
+                "fsdkr_tpu/knobs.py", line, "knob-dead",
+                f"{name} declared but never read by any scanned file",
+            ))
+    return findings

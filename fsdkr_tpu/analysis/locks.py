@@ -1,0 +1,454 @@
+"""Lock-discipline pass: static lock-acquisition graph + blocking-call
+audit over every ``with <lock>:`` body.
+
+This is the defect class the last three review cycles kept finding by
+hand (the submit-WAL-fsync stall, the parked-executor-thread
+starvation, the ingress release-ordering wedge), mechanized:
+
+- ``lock-order``: the pass collects every lock construction
+  (``threading.Lock()`` / ``RLock()``, plus ``threading.Condition(L)``
+  aliases), resolves ``with self._lock:`` / ``with _LOCK:`` acquisition
+  sites, follows resolvable project calls (receiver types from the
+  shared ProjectIndex) to a fixpoint "may acquire" summary per
+  function, and flags any cycle in the resulting lock-order graph.
+- ``lock-blocking-call``: flags blocking work — ``os.fsync``, socket
+  I/O, ``time.sleep``, subprocess waits, bare ``.join()``/``.wait()``,
+  and the native/powm batch entry points — executed while a lock is
+  held, either directly in the ``with`` body or via a resolvable
+  project call (one level of the chain is named in the finding).
+  ``Condition.wait`` on a condition bound to the held lock is exempt
+  (it *releases* the lock — that is the point of a CV).
+
+Deliberate residuals carry inline suppressions with reasons (e.g. the
+journal's fsync under its own lock IS the WAL ordering domain). The
+static graph is validated at runtime by the FSDKR_LOCK_CHECK watchdog
+(`fsdkr_tpu.analysis.lockwatch`) during tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .common import Finding, ProjectIndex, SourceFile, dotted_name, \
+    iter_functions
+
+__all__ = ["run", "RULES"]
+
+RULES = ("lock-order", "lock-blocking-call")
+
+# blocking calls by full dotted name
+_BLOCKING_DOTTED = {
+    "os.fsync": "fsync",
+    "os.fdatasync": "fsync",
+    "time.sleep": "sleep",
+    "select.select": "select",
+    "subprocess.run": "subprocess",
+    "subprocess.call": "subprocess",
+    "subprocess.check_call": "subprocess",
+    "subprocess.check_output": "subprocess",
+    "subprocess.Popen": "subprocess",
+}
+# blocking method names (attribute calls on any receiver)
+_BLOCKING_METHODS = {
+    "recv": "socket recv", "recv_into": "socket recv",
+    "sendall": "socket send", "accept": "socket accept",
+    "connect": "socket connect", "communicate": "subprocess wait",
+}
+# native / engine batch entry points: anything routed here does seconds
+# of GIL-releasing work — never hold a service lock across it
+_ENGINE_RE = re.compile(
+    r"(^|\.)(modexp\w*|host_powm|tpu_powm\w*|crt_powm|multi_powm\w*|"
+    r"miller_rabin\w*|keygen_batch|gen_primes\w*|gen_moduli\w*|"
+    r"batch_scalar_mul|batch_msm|verify_pairs|distribute_batch|"
+    r"collect\w*|finalize_streams)$"
+)
+
+
+@dataclass
+class _FuncInfo:
+    sf: SourceFile
+    qual: str                      # module-level qualname (Class.meth)
+    cls: Optional[str]
+    node: ast.AST
+    acquires: Set[str] = field(default_factory=set)   # lock ids
+    blocks: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+    # blocks: reason -> (line, depth) — depth 0 = blocks directly
+
+    @property
+    def fid(self) -> str:
+        return f"{self.sf.module}:{self.qual}"
+
+
+class _Locks:
+    """Lock constructions and condition aliases for the whole project."""
+
+    def __init__(self) -> None:
+        # (module, class_or_None, attr) -> lock id
+        self.defs: Dict[Tuple[str, Optional[str], str], str] = {}
+        # condition alias -> lock id, same key shape
+        self.cv: Dict[Tuple[str, Optional[str], str], str] = {}
+        # attr name -> set of lock ids (for cross-class fallback)
+        self.by_attr: Dict[str, Set[str]] = {}
+
+    def define(self, module: str, cls: Optional[str], attr: str) -> str:
+        lock_id = f"{module}.{cls}.{attr}" if cls else f"{module}.{attr}"
+        self.defs[(module, cls, attr)] = lock_id
+        self.by_attr.setdefault(attr, set()).add(lock_id)
+        return lock_id
+
+    def resolve(self, module: str, cls: Optional[str], expr: ast.AST,
+                index: ProjectIndex) -> Optional[str]:
+        """Lock id for a `with <expr>:` context, else None."""
+        name = dotted_name(expr)
+        if not name:
+            return None
+        parts = name.split(".")
+        # self._lock / self._work_cv
+        if len(parts) == 2 and parts[0] in ("self", "cls"):
+            attr = parts[1]
+            for table in (self.defs, self.cv):
+                hit = table.get((module, cls, attr))
+                if hit:
+                    return hit
+            # method defined in a different class of the same module
+            # (mixins) — fall back on attr-name uniqueness
+            ids = self.by_attr.get(attr, set())
+            if len(ids) == 1:
+                return next(iter(ids))
+            return None
+        # module-level _LOCK
+        if len(parts) == 1:
+            for table in (self.defs, self.cv):
+                hit = table.get((module, None, parts[0]))
+                if hit:
+                    return hit
+            return None
+        # foreign attr chain x._lock: resolve receiver class by index
+        attr = parts[-1]
+        recv_cls = index.receiver_class(".".join(parts[:-1]))
+        if recv_cls:
+            info = index.classes.get(recv_cls)
+            if info:
+                for table in (self.defs, self.cv):
+                    hit = table.get((info.module, recv_cls, attr))
+                    if hit:
+                        return hit
+        ids = self.by_attr.get(attr, set())
+        if len(ids) == 1:
+            return next(iter(ids))
+        return None
+
+    def cv_lock(self, module: str, cls: Optional[str], recv: str
+                ) -> Optional[str]:
+        """If recv names a Condition alias, the lock it wraps."""
+        parts = recv.split(".")
+        attr = parts[-1]
+        if parts[0] in ("self", "cls") or len(parts) == 1:
+            return self.cv.get((module, cls, attr)) \
+                or self.cv.get((module, None, attr))
+        return None
+
+
+def _collect_locks(files: List[SourceFile]) -> _Locks:
+    locks = _Locks()
+    for sf in files:
+        def scan(node, cls: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    scan(child, child.name)
+                    continue
+                if isinstance(child, ast.Assign) and isinstance(
+                        child.value, ast.Call):
+                    ctor = dotted_name(child.value.func) or ""
+                    ctor_last = ctor.split(".")[-1]
+                    for t in child.targets:
+                        tn = dotted_name(t)
+                        if not tn:
+                            continue
+                        tparts = tn.split(".")
+                        owner = cls if tparts[0] in ("self", "cls") \
+                            else None
+                        attr = tparts[-1]
+                        if len(tparts) > 2 or (len(tparts) == 2 and
+                                               owner is None):
+                            continue
+                        if ctor_last in ("Lock", "RLock") and \
+                                ctor.split(".")[0] in ("threading", "Lock",
+                                                       "RLock"):
+                            locks.define(sf.module, owner, attr)
+                        elif ctor_last == "Condition":
+                            args = child.value.args
+                            if args:
+                                inner = dotted_name(args[0])
+                                if inner:
+                                    iparts = inner.split(".")
+                                    iowner = cls if iparts[0] in (
+                                        "self", "cls") else None
+                                    hit = locks.defs.get(
+                                        (sf.module, iowner, iparts[-1]))
+                                    if hit:
+                                        locks.cv[(sf.module, owner,
+                                                  attr)] = hit
+                                        continue
+                            # bare Condition(): owns a private lock
+                            lid = locks.define(sf.module, owner, attr)
+                            locks.cv[(sf.module, owner, attr)] = lid
+                scan(child, cls)
+
+        scan(sf.tree, None)
+    return locks
+
+
+def _direct_blocking(call: ast.Call, module: str, cls: Optional[str],
+                     locks: _Locks, held: List[str]) -> Optional[str]:
+    name = dotted_name(call.func)
+    if not name:
+        return None
+    if name in _BLOCKING_DOTTED:
+        return _BLOCKING_DOTTED[name]
+    parts = name.split(".")
+    meth = parts[-1]
+    if meth in ("wait", "wait_for") and len(parts) > 1:
+        cv = locks.cv_lock(module, cls, ".".join(parts[:-1]))
+        if cv is not None and cv in held:
+            return None  # CV wait on the held lock releases it: correct
+        if cv is not None:
+            return "condition wait (foreign lock)"
+        return "wait"
+    if meth == "join" and len(parts) > 1 and not call.args:
+        # thread/process join; str.join always has the iterable arg
+        return "join"
+    if meth in _BLOCKING_METHODS:
+        return _BLOCKING_METHODS[meth]
+    if _ENGINE_RE.search(name):
+        return f"engine entry point {meth}"
+    return None
+
+
+def _resolve_call(call: ast.Call, info: _FuncInfo, index: ProjectIndex,
+                  funcs: Dict[str, _FuncInfo]) -> Optional[_FuncInfo]:
+    """Resolve a call to a project function summary, best effort."""
+    name = dotted_name(call.func)
+    if not name:
+        return None
+    parts = name.split(".")
+    module = info.sf.module
+    if parts[0] in ("self", "cls") and len(parts) == 2 and info.cls:
+        return funcs.get(f"{module}:{info.cls}.{parts[1]}")
+    if len(parts) == 1:
+        return funcs.get(f"{module}:{parts[0]}")
+    # typed receiver: x.meth / self._journal.append
+    recv_cls = index.receiver_class(".".join(parts[:-1]))
+    if recv_cls:
+        cinfo = index.classes.get(recv_cls)
+        if cinfo and parts[-1] in cinfo.methods:
+            return funcs.get(f"{cinfo.module}:{recv_cls}.{parts[-1]}")
+    return None
+
+
+def run(files: List[SourceFile], index: ProjectIndex) -> List[Finding]:
+    return analyze(files, index)[0]
+
+
+def analyze(files: List[SourceFile], index: ProjectIndex
+            ) -> Tuple[List[Finding],
+                       Dict[Tuple[str, str], Tuple[str, int]]]:
+    """(findings, lock-order edge map) — the edge map is the static
+    lock-acquisition graph, exposed for tests and for cross-validation
+    against the FSDKR_LOCK_CHECK runtime watchdog."""
+    locks = _collect_locks(files)
+    funcs: Dict[str, _FuncInfo] = {}
+    for sf in files:
+        for qual, cls, node in iter_functions(sf.tree):
+            info = _FuncInfo(sf, qual, cls, node)
+            funcs[info.fid] = info
+
+    # pass 1: per-function direct acquires + direct blocking reasons
+    for info in funcs.values():
+        module, cls = info.sf.module, info.cls
+
+        def walk(node, held: List[str]):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not info.node:
+                return  # nested functions summarized separately
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in node.items:
+                    lid = locks.resolve(module, cls, item.context_expr,
+                                        index)
+                    if lid:
+                        info.acquires.add(lid)
+                        acquired.append(lid)
+                for child in node.body:
+                    walk(child, held + acquired)
+                return
+            if isinstance(node, ast.Call):
+                reason = _direct_blocking(node, module, cls, locks, held)
+                if reason and reason not in info.blocks:
+                    info.blocks[reason] = (node.lineno, 0)
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in info.node.body:
+            walk(stmt, [])
+
+    # pass 2: fixpoint propagation through resolvable calls — `acquires`
+    # flows transitively (lock-order edges care about the full closure);
+    # blocking reasons flow at most TWO hops (callee direct, or callee's
+    # own one-hop summary) so findings stay attributable and a deep call
+    # chain into the engines doesn't flag every caller in the package
+    changed = True
+    rounds = 0
+    while changed and rounds < 20:
+        changed = False
+        rounds += 1
+        for info in funcs.values():
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _resolve_call(node, info, index, funcs)
+                if callee is None or callee is info:
+                    continue
+                new = callee.acquires - info.acquires
+                if new:
+                    info.acquires |= new
+                    changed = True
+                for reason, (line, depth) in callee.blocks.items():
+                    if depth >= 2:
+                        continue
+                    if reason not in info.blocks:
+                        info.blocks[reason] = (node.lineno, depth + 1)
+                        changed = True
+
+    # pass 3: findings — edges + blocking under held locks. Alongside
+    # each lock-blocking-call finding, remember WHICH lock's critical
+    # sections block: acquiring such a lock while holding another is
+    # the submit-WAL-fsync stall shape even when the blocking work is
+    # buried too deep for the chain cap (the journal fsyncs under its
+    # OWN lock — the defect is taking that lock under the service's).
+    findings: List[Finding] = []
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    edge_sites: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+    blocking_locks: Dict[str, str] = {}  # lock id -> first reason
+
+    def _note_edge(h: str, lid: str, rel: str, lineno: int) -> None:
+        edges.setdefault((h, lid), (rel, lineno))
+        edge_sites.setdefault((h, lid), []).append((rel, lineno))
+
+    def _note_blocking(held: List[str], reason: str) -> None:
+        for h in held:
+            blocking_locks.setdefault(h, reason.split(" [")[0])
+
+    for info in funcs.values():
+        module, cls = info.sf.module, info.cls
+
+        def walk(node, held: List[str]):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not info.node:
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in node.items:
+                    lid = locks.resolve(module, cls, item.context_expr,
+                                        index)
+                    if lid:
+                        for h in held + acquired:
+                            if h != lid:
+                                _note_edge(h, lid, info.sf.rel,
+                                           node.lineno)
+                        acquired.append(lid)
+                for child in node.body:
+                    walk(child, held + acquired)
+                return
+            if isinstance(node, ast.Call) and held:
+                reason = _direct_blocking(node, module, cls, locks, held)
+                if reason:
+                    findings.append(Finding(
+                        info.sf.rel, node.lineno, "lock-blocking-call",
+                        f"blocking call ({reason}) while holding "
+                        f"{held[-1]}",
+                    ))
+                    _note_blocking(held, reason)
+                else:
+                    callee = _resolve_call(node, info, index, funcs)
+                    if callee is not None and callee is not info:
+                        for lid in callee.acquires:
+                            for h in held:
+                                if h != lid:
+                                    _note_edge(h, lid, info.sf.rel,
+                                               node.lineno)
+                        for reason, (line, depth) in sorted(
+                                callee.blocks.items()):
+                            if depth > 1:
+                                continue  # keep findings attributable
+                            findings.append(Finding(
+                                info.sf.rel, node.lineno,
+                                "lock-blocking-call",
+                                f"call into {callee.qual} may block "
+                                f"({reason}) while holding {held[-1]}",
+                            ))
+                            _note_blocking(held, reason)
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in info.node.body:
+            walk(stmt, [])
+
+    # blocking-lock edges: taking a lock whose regions block (per the
+    # _note_blocking facts above — `Journal.append` fsyncs under its
+    # own lock, a documented-suppressed finding, which still marks
+    # Journal._lock as blocking), while holding any other lock, stalls
+    # every peer of the OUTER lock
+    for (a, b), sites in sorted(edge_sites.items()):
+        if b in blocking_locks:
+            for rel, lineno in sites:
+                findings.append(Finding(
+                    rel, lineno, "lock-blocking-call",
+                    f"acquires {b} — whose critical sections block "
+                    f"({blocking_locks[b]}) — while holding {a}",
+                ))
+
+    # cycle detection over the static order graph
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+
+    seen_cycles: Set[frozenset] = set()
+
+    def find_cycle(start: str) -> Optional[List[str]]:
+        stack: List[Tuple[str, List[str]]] = [(start, [start])]
+        visited: Set[str] = set()
+        while stack:
+            node, path = stack.pop()
+            for nxt in graph.get(node, ()):
+                if nxt == start:
+                    return path + [start]
+                if nxt not in visited:
+                    visited.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    for a in sorted(graph):
+        cyc = find_cycle(a)
+        if cyc:
+            key = frozenset(cyc)
+            if key in seen_cycles:
+                continue
+            seen_cycles.add(key)
+            site = edges.get((cyc[0], cyc[1]), ("?", 0))
+            findings.append(Finding(
+                site[0], site[1], "lock-order",
+                "lock-order cycle: " + " -> ".join(cyc),
+            ))
+
+    return findings, edges
+
+
+def static_edges(files: List[SourceFile], index: ProjectIndex
+                 ) -> Dict[Tuple[str, str], Tuple[str, int]]:
+    """The static lock-order edge set (tests; lockwatch
+    cross-validation tooling)."""
+    return analyze(files, index)[1]

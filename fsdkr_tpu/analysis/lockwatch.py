@@ -1,0 +1,337 @@
+"""Runtime lock-order watchdog (``FSDKR_LOCK_CHECK=1``) — the dynamic
+counterpart of the static lock pass (`fsdkr_tpu.analysis.locks`).
+
+``install()`` replaces ``threading.Lock`` / ``threading.RLock`` with
+factories that hand fsdkr_tpu code (construction-site filtered) tracked
+wrappers. Each wrapper records its construction site; every acquisition
+while other tracked locks are held adds a ``held -> acquiring`` edge to
+a process-global order graph, lockdep-style. An acquisition whose
+reverse path already exists in the graph is a **lock-order violation**:
+two threads interleaving those regions can deadlock, even if this run
+did not. Violations are counted
+(``fsdkr_lock_order_violations``), stamped into the flight recorder
+like injected faults (kind ``lock_check``), and kept for
+``violations()`` — tier-1's conftest fails the session on any.
+
+The wrappers are Condition-compatible: a plain-Lock wrapper exposes
+acquire/release/locked and lets ``threading.Condition`` fall back to
+its acquire(False) ownership probe; the RLock wrapper implements
+``_is_owned`` / ``_release_save`` / ``_acquire_restore`` itself. CV
+waits therefore pop and re-push held state through the same
+bookkeeping, so a ``cv.wait()`` never reads as holding the lock.
+
+Deliberately NOT installed outside tests: the bookkeeping costs one
+dict touch per acquisition on every hot lock. ``FSDKR_LOCK_CHECK`` is a
+debug knob, default off everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import _thread
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = [
+    "install",
+    "uninstall",
+    "installed",
+    "enabled",
+    "violations",
+    "edges",
+    "reset",
+    "make_lock",
+    "make_rlock",
+]
+
+_REAL_LOCK = _thread.allocate_lock
+_REAL_RLOCK = threading.RLock
+
+_state = _thread.allocate_lock()          # guards the graph (untracked)
+_edges: Dict[str, Set[str]] = {}          # site -> sites acquired under it
+_edge_sites: Dict[Tuple[str, str], str] = {}
+_violations: List[dict] = []
+_installed = False
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    return os.environ.get("FSDKR_LOCK_CHECK", "0").lower() not in (
+        "", "0", "false", "off")
+
+
+def _held() -> List["_TrackedBase"]:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _busy() -> bool:
+    return getattr(_tls, "busy", False)
+
+
+def _path_exists(src: str, dst: str) -> Optional[List[str]]:
+    """DFS: a path src -> ... -> dst in the order graph (caller holds
+    _state)."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in _edges.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _stamp(violation: dict) -> None:
+    """Flight-recorder + counter stamp, like an injected fault. Guarded
+    against re-entrancy: the telemetry layer takes its own (tracked)
+    locks."""
+    _tls.busy = True
+    try:
+        from ..telemetry import flight, registry
+
+        registry.counter(
+            "fsdkr_lock_order_violations",
+            "runtime lock-order violations (FSDKR_LOCK_CHECK watchdog)",
+        ).inc()
+        flight.record(
+            "lock_check", "order_violation",
+            held=violation["held"], acquiring=violation["acquiring"],
+            thread=violation["thread"],
+        )
+    except Exception:
+        pass  # the watchdog must never take the process down
+    finally:
+        _tls.busy = False
+
+
+def _note_acquire(lock: "_TrackedBase") -> None:
+    if _busy():
+        return
+    held = _held()
+    new_violations = []
+    with _state:
+        for h in held:
+            if h.site == lock.site:
+                continue
+            edge = (h.site, lock.site)
+            if edge not in _edge_sites:
+                # reverse path first: adding this edge would close a
+                # cycle — that interleaving is a deadlock waiting for
+                # the right schedule
+                rev = _path_exists(lock.site, h.site)
+                if rev is not None:
+                    v = {
+                        "held": h.site,
+                        "acquiring": lock.site,
+                        "thread": threading.current_thread().name,
+                        "cycle": rev + [lock.site],
+                    }
+                    _violations.append(v)
+                    new_violations.append(v)
+                _edge_sites[edge] = threading.current_thread().name
+                _edges.setdefault(h.site, set()).add(lock.site)
+    held.append(lock)
+    for v in new_violations:
+        _stamp(v)
+
+
+def _note_release(lock: "_TrackedBase") -> None:
+    if _busy():
+        return
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] is lock:
+            del held[i]
+            return
+
+
+class _TrackedBase:
+    def __init__(self, site: str):
+        self.site = site
+
+
+class _TrackedLock(_TrackedBase):
+    """threading.Lock wrapper with order tracking."""
+
+    def __init__(self, site: str):
+        super().__init__(site)
+        self._lock = _REAL_LOCK()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            _note_acquire(self)
+        return got
+
+    def release(self) -> None:
+        _note_release(self)
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<TrackedLock {self.site} locked={self.locked()}>"
+
+
+class _TrackedRLock(_TrackedBase):
+    """threading.RLock wrapper: order noted on FIRST acquisition only,
+    Condition-compatible via the private RLock protocol."""
+
+    def __init__(self, site: str):
+        super().__init__(site)
+        self._lock = _REAL_LOCK()
+        self._owner: Optional[int] = None
+        self._count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        me = _thread.get_ident()
+        if self._owner == me:
+            self._count += 1
+            return True
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._owner = me
+            self._count = 1
+            _note_acquire(self)
+        return got
+
+    __enter__ = acquire
+
+    def release(self) -> None:
+        if self._owner != _thread.get_ident():
+            raise RuntimeError("cannot release un-acquired lock")
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+            _note_release(self)
+            self._lock.release()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # Condition protocol
+    def _is_owned(self) -> bool:
+        return self._owner == _thread.get_ident()
+
+    def _release_save(self):
+        count, self._count = self._count, 0
+        self._owner = None
+        _note_release(self)
+        self._lock.release()
+        return count
+
+    def _acquire_restore(self, count) -> None:
+        self._lock.acquire()
+        self._owner = _thread.get_ident()
+        self._count = count
+        _note_acquire(self)
+
+    def __repr__(self) -> str:
+        return f"<TrackedRLock {self.site} count={self._count}>"
+
+
+def _caller_site(depth: int = 2) -> Tuple[str, bool]:
+    """(construction site 'file:line', is_fsdkr) of the caller."""
+    f = sys._getframe(depth)
+    fname = f.f_code.co_filename
+    site = f"{os.path.basename(fname)}:{f.f_lineno}"
+    return site, ("fsdkr_tpu" in fname or "test_analysis" in fname)
+
+
+def make_lock(site: str) -> _TrackedLock:
+    """Explicitly tracked lock (tests, fixtures)."""
+    return _TrackedLock(site)
+
+
+def make_rlock(site: str) -> _TrackedRLock:
+    return _TrackedRLock(site)
+
+
+def _lock_factory():
+    site, ours = _caller_site()
+    return _TrackedLock(site) if ours else _REAL_LOCK()
+
+
+def _rlock_factory():
+    site, ours = _caller_site()
+    return _TrackedRLock(site) if ours else _REAL_RLOCK()
+
+
+def install() -> None:
+    """Patch threading.Lock/RLock. Call BEFORE importing fsdkr_tpu
+    modules (module-level locks are created at import time); jax and
+    the stdlib keep real locks (construction-site filter)."""
+    global _installed
+    if _installed:
+        return
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def violations() -> List[dict]:
+    with _state:
+        return list(_violations)
+
+
+def edges() -> Dict[str, Set[str]]:
+    with _state:
+        return {k: set(v) for k, v in _edges.items()}
+
+
+def reset() -> None:
+    with _state:
+        _edges.clear()
+        _edge_sites.clear()
+        _violations.clear()
+
+
+def snapshot_state() -> dict:
+    """Copy of the global graph + violations, for tests that must
+    isolate their own planted inversions WITHOUT wiping violations an
+    earlier test legitimately recorded (the FSDKR_LOCK_CHECK session
+    gate reads the global list at sessionfinish)."""
+    with _state:
+        return {
+            "edges": {k: set(v) for k, v in _edges.items()},
+            "edge_sites": dict(_edge_sites),
+            "violations": list(_violations),
+        }
+
+
+def restore_state(saved: dict) -> None:
+    with _state:
+        _edges.clear()
+        _edges.update({k: set(v) for k, v in saved["edges"].items()})
+        _edge_sites.clear()
+        _edge_sites.update(saved["edge_sites"])
+        _violations.clear()
+        _violations.extend(saved["violations"])

@@ -1,0 +1,295 @@
+"""Secret-flow taint pass: no secret carrier may reach a persistence or
+export sink without a declared sanitizer in between.
+
+SECURITY.md names the secret carriers this repo handles — LocalKeys
+(``paillier_dk``, ``keys_linear``), Paillier ``DecryptionKey`` p/q,
+Shamir shares, precompute ``PoolEntry`` payloads, CRT contexts, and
+``MemoryKeystore`` deposits — and thirteen discipline sections promise
+they never reach the public surfaces: the journal, the ingress wire,
+telemetry labels/attrs/flight fields, the public LRU, logs, or bench
+JSON. Until now every one of those promises was enforced only by
+runtime grep tests over the paths a test happens to exercise. This pass
+checks the promise on every code path, mechanically.
+
+Model (deliberately intra-procedural — the planted-violation fixtures
+in tests/test_analysis.py pin exactly what it must catch):
+
+- **Sources.** A name becomes tainted when bound from: a parameter
+  whose name is a known secret carrier (``dk``, ``dks``, ``local_key``,
+  ``keys`` ...); a call returning secret material (``paillier.keygen``,
+  ``simulate_keygen``, pool ``take``, keystore getters, CRT context
+  constructors); or an attribute access naming a secret field
+  (``.paillier_dk``, ``.keys_linear``, ``.dk``, a DecryptionKey's
+  ``.p``/``.q`` — matched only through an already-tainted base for the
+  ambiguous short names, so a curve's public ``.p`` stays clean).
+- **Propagation.** Assignment, tuple unpack, f-strings, str/repr/hex,
+  containers, subscripts, attributes of tainted bases, loop variables
+  over tainted iterables, and augmented assignment all carry taint.
+  Ordinary calls do NOT propagate (a hash, a length, a count of a
+  secret is public by this codebase's rules) — the sanitizer set is the
+  default, not the exception, which keeps the pass quiet on the 100+
+  legitimate secret *computations* per module.
+- **Sinks.** A tainted expression in an argument (or keyword) of:
+  ``journal.append`` / ``_jappend*``; ``encode_frame`` / ``_write_frame``
+  / ``sendall`` (ingress wire); ``.labels(...)`` / ``flight.record`` /
+  ``telemetry.phase(**attrs)`` / metric ``set``/``inc``/``observe``
+  (telemetry); the public LRU's ``cache.put`` / ``global_cache().put``;
+  ``logging``/``print``; ``json.dump(s)`` (bench/report emission).
+
+Findings name the flow: source name, sink kind, line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional
+
+from .common import Finding, ProjectIndex, SourceFile, dotted_name, \
+    iter_functions
+
+__all__ = ["run", "RULES"]
+
+RULES = ("secret-flow",)
+
+# parameters that carry secret material by this codebase's naming
+# conventions (SECURITY.md carriers; 'key'/'keys' of dict-key fame are
+# disambiguated: bare `key` is NOT here, `keys` — always LocalKeys in
+# this repo — is)
+SECRET_PARAMS = {
+    "dk", "dks", "new_dk", "dk_new", "paillier_dk", "local_key",
+    "local_keys", "keys", "new_keys", "secret", "secrets", "shares",
+    "new_shares", "crt_ctx", "secret_values",
+}
+
+# calls whose results are secret carriers (match on the dotted tail)
+_SOURCE_CALL_RE = re.compile(
+    r"(^|\.)(keygen|keygen_batch|simulate_keygen|take|committee_keys|"
+    r"session_dks|get_context|secret_values|sample_stage1|"
+    r"sample_commit)(\(\))?$"
+)
+
+# attribute names that are secret on ANY base
+SECRET_ATTRS_ALWAYS = {"paillier_dk", "keys_linear", "new_dk"}
+# attribute names that are secret only on an already-tainted base
+SECRET_ATTRS_TAINTED_BASE = {"p", "q", "dk", "x_i", "p_leg", "q_leg",
+                             "d_p", "d_q", "qinv", "values"}
+# PUBLIC fields of the secret carriers: reading one of these off a
+# tainted base yields broadcast-public data (the LocalKey dataclass
+# split — SECURITY.md's "queue holds public data only" rule depends on
+# exactly these fields being safe to export)
+PUBLIC_ATTRS = {"t", "n", "i", "nn", "ek", "pk_vec", "y_sum_s",
+                "paillier_key_vec", "h1_h2_n_tilde_vec", "vss_scheme",
+                "modulus"}
+
+# calls that launder taint explicitly (results clean; being the
+# argument of one of these is NOT a sink) — hashing, counting, wiping
+_CLEAN_CALL_RE = re.compile(
+    r"(^|\.)(len|bool|type|id|hash|sha256|sha512|blake2b|hexdigest|"
+    r"digest|fingerprint|shard_for|check_label_value|sanitize_fields|"
+    r"zeroize\w*|wipe\w*|secure_wipe|bit_length)$"
+)
+
+# builtins/conversions that PROPAGATE taint through their result
+_PROPAGATE_CALLS = {
+    "str", "repr", "hex", "oct", "bytes", "bytearray", "int", "list",
+    "tuple", "set", "dict", "sorted", "reversed", "format", "vars",
+    "deepcopy", "copy.deepcopy", "copy.copy", "abs", "pow", "divmod",
+}
+
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "log"}
+
+
+def _sink_kind(call: ast.Call, index: ProjectIndex) -> Optional[str]:
+    """Classify a call as a sink. Returns a short kind or None."""
+    name = dotted_name(call.func)
+    if not name:
+        return None
+    parts = name.split(".")
+    meth = parts[-1]
+    recv = ".".join(parts[:-1])
+    recv_last = parts[-2].rstrip("()") if len(parts) > 1 else ""
+
+    if meth == "append" and len(parts) > 1:
+        cls = index.receiver_class(recv)
+        if cls == "Journal" or "journal" in recv_last.lower():
+            return "journal append"
+    if meth in ("_jappend", "_jappend_safe"):
+        return "journal append"
+    if meth in ("encode_frame", "_write_frame", "sendall", "send") \
+            and (meth != "send" or "sock" in recv_last.lower()
+                 or "conn" in recv_last.lower()
+                 or "transport" in recv_last.lower()):
+        return "wire frame"
+    if meth == "labels":
+        return "telemetry label"
+    if meth == "record" and recv_last in ("flight", ""):
+        return "flight-recorder field"
+    if meth == "phase" and recv_last in ("telemetry", "spans", "tracer",
+                                         "_tracer") and call.keywords:
+        return "span attribute"
+    if meth in ("set", "inc", "observe") and (
+            "gauge" in recv.lower() or "counter" in recv.lower()
+            or "hist" in recv.lower() or "metric" in recv.lower()):
+        return "telemetry metric"
+    if meth == "put" and len(parts) > 1:
+        cls = index.receiver_class(recv)
+        low = recv_last.lower()
+        if cls == "BudgetLRU" or "cache" in low or "lru" in low \
+                or recv.endswith("global_cache()"):
+            return "public LRU"
+    if parts[0] in ("logging", "log", "logger") and meth in _LOG_METHODS:
+        return "log"
+    if meth == "print" and len(parts) == 1:
+        return "log"
+    if name in ("json.dump", "json.dumps"):
+        return "JSON emission"
+    return None
+
+
+class _FnTaint(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile, fn: ast.AST,
+                 index: ProjectIndex, findings: List[Finding]):
+        self.sf = sf
+        self.fn = fn
+        self.index = index
+        self.findings = findings
+        self.tainted: Dict[str, str] = {}  # name -> source description
+        args = fn.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            if a.arg in SECRET_PARAMS:
+                self.tainted[a.arg] = f"parameter {a.arg!r}"
+
+    # -- expression taint ----------------------------------------------
+    def taint_of(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return self.tainted.get(node.id)
+        if isinstance(node, ast.Attribute):
+            if node.attr in SECRET_ATTRS_ALWAYS:
+                return f"secret field .{node.attr}"
+            base = self.taint_of(node.value)
+            if base is None:
+                return None
+            if node.attr in PUBLIC_ATTRS:
+                return None  # the carrier's declared-public fields
+            if node.attr in SECRET_ATTRS_TAINTED_BASE:
+                return f"{base}.{node.attr}"
+            return base
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            if _CLEAN_CALL_RE.search(name):
+                return None
+            if _SOURCE_CALL_RE.search(name):
+                return f"call {name.split('.')[-1]}()"
+            if name in _PROPAGATE_CALLS or \
+                    name.split(".")[-1] in _PROPAGATE_CALLS:
+                for a in node.args:
+                    t = self.taint_of(a)
+                    if t:
+                        return t
+            return None
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            for e in node.elts:
+                t = self.taint_of(e)
+                if t:
+                    return t
+            return None
+        if isinstance(node, ast.Dict):
+            for e in list(node.keys) + list(node.values):
+                if e is None:
+                    continue
+                t = self.taint_of(e)
+                if t:
+                    return t
+            return None
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    t = self.taint_of(v.value)
+                    if t:
+                        return t
+            return None
+        if isinstance(node, ast.FormattedValue):
+            return self.taint_of(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.taint_of(node.left) or self.taint_of(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.taint_of(node.operand)
+        if isinstance(node, ast.Subscript):
+            return self.taint_of(node.value)
+        if isinstance(node, ast.Starred):
+            return self.taint_of(node.value)
+        if isinstance(node, ast.IfExp):
+            return self.taint_of(node.body) or self.taint_of(node.orelse)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            # comprehension over a tainted iterable yields tainted items
+            for gen in node.generators:
+                t = self.taint_of(gen.iter)
+                if t:
+                    return t
+            return self.taint_of(node.elt)
+        return None
+
+    # -- statements ----------------------------------------------------
+    def _bind(self, target: ast.AST, taint: Optional[str]) -> None:
+        if isinstance(target, ast.Name):
+            if taint:
+                self.tainted[target.id] = taint
+            else:
+                self.tainted.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, taint)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, taint)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        t = self.taint_of(node.value)
+        for target in node.targets:
+            self._bind(target, t)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._bind(node.target, self.taint_of(node.value))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        t = self.taint_of(node.value) or self.taint_of(node.target)
+        self._bind(node.target, t)
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        self._bind(node.target, self.taint_of(node.iter))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node is not self.fn:
+            return  # nested functions get their own visitor
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        kind = _sink_kind(node, self.index)
+        if kind:
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                t = self.taint_of(arg)
+                if t:
+                    self.findings.append(Finding(
+                        self.sf.rel, node.lineno, "secret-flow",
+                        f"secret ({t}) reaches {kind} without a "
+                        "declared sanitizer",
+                    ))
+                    break
+        self.generic_visit(node)
+
+
+def run(files: List[SourceFile], index: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        for qual, cls, fn in iter_functions(sf.tree):
+            _FnTaint(sf, fn, index, findings).visit(fn)
+    return findings

@@ -1,0 +1,94 @@
+"""Central registry of every FSDKR_* environment knob.
+
+Single source of truth for the knob surface (ISSUE 14): every
+``FSDKR_*`` environment read anywhere in the package or scripts must
+have a row here, and every row must have a matching entry in README.md's
+knob table — both enforced statically by the knob-drift pass
+(`fsdkr_tpu.analysis.knobs`, run by ``scripts/fsdkr_lint.py`` and the
+ci.sh analysis leg). A knob declared here but read nowhere is flagged as
+dead; a read of an undeclared knob is flagged as drift.
+
+KNOBS must stay a PURE dict literal (name -> one-line description): the
+static pass reads it with ``ast.literal_eval`` so linting never has to
+import jax or the package.
+"""
+
+from __future__ import annotations
+
+__all__ = ["KNOBS"]
+
+KNOBS = {
+    # -- engines / A-B gates ------------------------------------------------
+    "FSDKR_RLC": "cross-proof randomized batch verification (1/0)",
+    "FSDKR_MULTIEXP": "joint multi-exponentiation planner (1/0)",
+    "FSDKR_RANGEOPT": "range-family verifier engines (1/0)",
+    "FSDKR_CRT": "secret-CRT prover engine (1/0)",
+    "FSDKR_GMP": "libgmp host bridge (1/0)",
+    "FSDKR_MPN": "GMP mpn Montgomery inner loop (auto/0)",
+    "FSDKR_PRECOMPUTE": "offline/online prover split via pools (1/0)",
+    "FSDKR_PRECOMPUTE_BG": "background precompute producer thread (1/0)",
+    "FSDKR_MEM_PLAN": "bytes-budgeted streaming verification plan (1/0)",
+    "FSDKR_PIPELINE": "double-buffered tile prefetch (1/0)",
+    "FSDKR_SCHED": "concurrent column scheduler workers (auto/int)",
+    "FSDKR_NATIVE_POW": "native C++ Montgomery host core (1/0)",
+    "FSDKR_NATIVE_EC": "native C++ EC core (1/0)",
+    "FSDKR_DEVICE_EC": "device EC hot-path routing (auto/1/0)",
+    "FSDKR_DEVICE_POWM": "device batched modexp routing (auto/1/0)",
+    "FSDKR_PALLAS": "fused Pallas MontMul kernels (auto/1/0)",
+    "FSDKR_NO_PALLAS": "bench-side hard disable of Pallas probes (1/0)",
+    # -- sizing / tuning ----------------------------------------------------
+    "FSDKR_THREADS": "native row-pool worker threads (auto/int)",
+    "FSDKR_TILE_ROWS": "native-path tile size in rows (0 = whole batch)",
+    "FSDKR_MAX_ROWS_PER_LAUNCH": "HBM tiling cap per device launch",
+    "FSDKR_RNS_MIN_ROWS": "CIOS/VPU vs RNS/MXU router crossover (rows)",
+    "FSDKR_DEVICE_MAX_TERMS": "device joint-ladder term cap",
+    "FSDKR_COMB_TREE": "log-depth comb combination tree (1/0)",
+    "FSDKR_COMB_TREE_BUDGET": "comb-tree table byte budget",
+    "FSDKR_MEM_BUDGET_MB": "staged-bytes budget of the memory plan (MB)",
+    "FSDKR_CACHE_BUDGET_MB": "persistent public precompute LRU budget (MB)",
+    "FSDKR_POOL_DEPTH": "per-(kind,key) precompute pool entry cap",
+    "FSDKR_POOL_BUDGET_MB": "total pooled-bytes budget (MB)",
+    "FSDKR_POOL_TTL_S": "wall-clock backstop retiring owned pool targets",
+    "FSDKR_PEAK_MACS": "roofline peak MAC/s override for mfu()",
+    "FSDKR_JAX_CACHE": "persistent XLA compilation-cache base directory",
+    # -- telemetry ----------------------------------------------------------
+    "FSDKR_TRACE": "per-phase span tracing (1/0)",
+    "FSDKR_TRACE_OUT": "Chrome-trace export path",
+    "FSDKR_TRACE_EVENTS": "recorded span cap",
+    "FSDKR_METRICS_DUMP": "Prometheus text exposition path",
+    "FSDKR_FLIGHT": "flight-recorder dump path (or 1 = default path)",
+    "FSDKR_FLIGHT_EVENTS": "flight ring size (events)",
+    "FSDKR_XPROF": "jax.profiler trace alongside the span tracer",
+    # -- serving ------------------------------------------------------------
+    "FSDKR_SERVE": "refresh-as-a-service scheduler (1/0)",
+    "FSDKR_SERVE_WORKERS": "prover-side worker threads",
+    "FSDKR_SERVE_BATCH": "fused finalize batch size cap",
+    "FSDKR_SERVE_LINGER_MS": "finalize coalescing linger budget (ms)",
+    "FSDKR_SERVE_SHUFFLE": "shuffled per-session arrival order (1/0)",
+    "FSDKR_SERVE_DEADLINE_S": "per-session deadline (0 = off)",
+    "FSDKR_SERVE_RETRIES": "transient-failure retry cap",
+    "FSDKR_SERVE_BACKOFF_MS": "retry backoff base (ms, jittered exp)",
+    "FSDKR_SERVE_HISTORY": "finished-session records retained",
+    "FSDKR_SERVE_MAX_QUEUE": "admission-control queue depth shed bound",
+    "FSDKR_SERVE_SHED_P99": "admission shed multiplier over SLO p99",
+    "FSDKR_SERVE_BISECT_BUDGET": "per-committee bisection budget",
+    "FSDKR_SERVE_BISECT_WINDOW_S": "bisection budget sliding window (s)",
+    "FSDKR_SERVE_HORIZON_S": "capacity-planner pool runway horizon (s)",
+    "FSDKR_SERVE_MAX_AHEAD": "capacity-planner epochs-ahead clamp",
+    "FSDKR_FAULTS": "deterministic fault-injection plan spec",
+    # -- ingress / journal --------------------------------------------------
+    "FSDKR_INGRESS_MAX_FRAME_MB": "TCP wire-frame size cap (MB)",
+    "FSDKR_INGRESS_INFLIGHT_MB": "server-global inflight byte budget (MB)",
+    "FSDKR_INGRESS_CONN_INFLIGHT_MB": "per-connection inflight budget (MB)",
+    "FSDKR_INGRESS_IDLE_S": "idle-connection hygiene sweep timeout (s)",
+    "FSDKR_INGRESS_WRITE_S": "slow-write (slow-loris) sweep timeout (s)",
+    "FSDKR_INGRESS_PEER_RPS": "per-peer token-bucket rate limit",
+    "FSDKR_INGRESS_HANDLERS": "executor threads for blocking ingress ops",
+    "FSDKR_JOURNAL_SYNC": "journal fsync policy (always/batch/off)",
+    "FSDKR_JOURNAL_BATCH": "records per fsync under batch policy",
+    "FSDKR_JOURNAL_SEGMENT_MB": "journal segment rotation size (MB)",
+    # -- bench / debug ------------------------------------------------------
+    "FSDKR_POINT_TIMEOUT": "per-point timeout of the kernel battery (s)",
+    "FSDKR_LOCK_CHECK": "runtime lock-order watchdog (1/0, tier-1 debug)",
+    "FSDKR_TEST_KEYGEN_CACHE": "session-scoped keygen cache in tests (1/0)",
+}

@@ -132,6 +132,7 @@ class NativeLib:
         if not self._tried:
             with self._lock:
                 if not self._tried:
+                    # fsdkr-lint: allow(lock-blocking-call) one-time double-checked build: racers SHOULD wait for the single compile
                     self._lib = self._build()
                     self._tried = True
         return self._lib

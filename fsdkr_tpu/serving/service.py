@@ -840,24 +840,30 @@ class RefreshService:
                 sess._not_before = now + backoff
                 sess.state = "pooled"
                 sess._streams = []
-                # WAL: the retried attempt re-runs distribute with fresh
-                # randomness, so the failed attempt's journaled
-                # broadcasts (and deposited dks) are stale — a replay
-                # mixing attempts would pair one attempt's messages
-                # with another's secrets. The reset record makes replay
-                # start from the latest attempt only.
-                self._jappend_safe({"t": "reset", "sid": sess.session_id})
-                if self.keystore is not None:
-                    self.keystore.drop_session(
-                        sess.committee_id, sess.session_id
-                    )
-                self._queue.append(sess.session_id)
-                metrics.queue_gauge().set(len(self._queue))
-                metrics.retries_counter().inc(stage="worker")
-                self._work_cv.notify()
                 requeue = True
         if not requeue:
             self._finish(sess, e, now)
+            return
+        # WAL the attempt boundary OUTSIDE the service lock (the
+        # journal fsyncs under its own lock — fsdkr-lint lock-blocking
+        # rule, same shape as submit's admission append): the retried
+        # attempt re-runs distribute with fresh randomness, so the
+        # failed attempt's journaled broadcasts (and deposited dks) are
+        # stale — a replay mixing attempts would pair one attempt's
+        # messages with another's secrets. The reset record makes
+        # replay start from the latest attempt only; ordering is safe
+        # because the session is not queued yet, so the next attempt
+        # cannot journal anything before the reset lands.
+        self._jappend_safe({"t": "reset", "sid": sess.session_id})
+        if self.keystore is not None:
+            self.keystore.drop_session(sess.committee_id, sess.session_id)
+        with self._lock:
+            if sess.state != "pooled":
+                return  # the reaper timed it out while we journaled
+            self._queue.append(sess.session_id)
+            metrics.queue_gauge().set(len(self._queue))
+            metrics.retries_counter().inc(stage="worker")
+            self._work_cv.notify()
 
     def _advance(self, sess: ServeSession, state: str) -> bool:
         """Move a session to a non-terminal lifecycle state, under the
@@ -1496,23 +1502,25 @@ class RefreshService:
                 submitted_at=time.monotonic(),
             )
             sess.state = "collecting"
-            # best-effort: this whole path is already degraded
-            # durability, and one journal IO failure here must not
-            # abort the caller's replay loop (a lost record just means
-            # the next recovery settles the origin session again)
-            self._jappend_safe(
-                {
-                    "t": "admitted",
-                    "sid": sess.session_id,
-                    "cid": committee_id,
-                    "epoch": epoch,
-                }
-            )
             if epoch is not None:
                 self._epoch_index[(committee_id, epoch)] = sess.session_id
             self._sessions[sess.session_id] = sess
             self._inflight += 1
             metrics.inflight_gauge().set(self._inflight)
+        # WAL OUTSIDE the service lock (journal fsyncs under its own
+        # lock); best-effort: this whole path is already degraded
+        # durability, and one journal IO failure here must not abort
+        # the caller's replay loop (a lost record just means the next
+        # recovery settles the origin session again). `admitted` still
+        # precedes the supersede/_finish terminals below.
+        self._jappend_safe(
+            {
+                "t": "admitted",
+                "sid": sess.session_id,
+                "cid": committee_id,
+                "epoch": epoch,
+            }
+        )
         self._supersede_journaled(
             origin_sid, committee_id, epoch, sess.session_id
         )
@@ -1558,14 +1566,6 @@ class RefreshService:
                 sess.deadline = now + self.deadline_s
             sess.state = "collecting"
             sess._config = com.config
-            self._jappend(
-                {
-                    "t": "admitted",
-                    "sid": sess.session_id,
-                    "cid": committee_id,
-                    "epoch": epoch,
-                }
-            )
             if epoch is not None:
                 self._epoch_index[(committee_id, epoch)] = sess.session_id
             self._sessions[sess.session_id] = sess
@@ -1576,9 +1576,21 @@ class RefreshService:
         # from here on the session owns the committee's busy slot and
         # the inflight count: ANY failure must settle it through
         # _finish (which releases both) — raising out of this method
-        # would leak the slot and wedge the committee forever
+        # would leak the slot and wedge the committee forever. The
+        # admission WAL append happens here, OUTSIDE the service lock
+        # (journal fsyncs under its own lock) and inside the
+        # settle-on-failure region; `admitted` still precedes
+        # `collecting` because both moved with it, in order.
         streams = []
         try:
+            self._jappend(
+                {
+                    "t": "admitted",
+                    "sid": sess.session_id,
+                    "cid": committee_id,
+                    "epoch": epoch,
+                }
+            )
             self._jappend(
                 {
                     "t": "collecting",

@@ -6,8 +6,10 @@
 #   test   -> the smoke tier: quick suite minus `heavy` kernel
 #             differentials (pytest.ini already excludes `slow`);
 #             session-scoped keygen caching makes this the <3 min gate
-#   lint   -> compileall + scripts/lint_imports.py (ast-based unused-
-#             import check; no pyflakes/ruff/black in the image)
+#   lint   -> compileall + scripts/fsdkr_lint.py (ISSUE 14: four AST
+#             passes — secret-flow taint, lock discipline, knob drift,
+#             unused-imports/layering — plus a planted-fixture gate
+#             proof; scripts/lint_imports.py survives as a shim)
 # Full suite on demand: pytest tests/ -m "not slow" (quick) or
 # pytest tests/ -m "" (everything, ~hours on this box).
 set -e
@@ -24,8 +26,66 @@ from fsdkr_tpu import config, errors
 print("import ok:", fsdkr_tpu.__name__)
 EOF
 
-echo "== lint: unused imports =="
-python scripts/lint_imports.py fsdkr_tpu tests scripts bench.py __graft_entry__.py
+echo "== lint: fsdkr-lint static analysis (taint + locks + knobs + imports) =="
+# the four-pass gate (ISSUE 14): secret-flow taint, lock discipline,
+# knob drift, and the old import/layering rules (scripts/lint_imports.py
+# is now a shim over the imports pass). Whole tree, no jax import, ~5 s.
+python scripts/fsdkr_lint.py
+
+echo "== lint: gate proof (planted violations must fail the driver) =="
+# a static gate that cannot catch a planted violation is a green light
+# painted on a wall: one fixture per pass, each run through the REAL
+# driver in a subprocess, each required to exit 1 naming the right rule
+python - <<'EOF'
+import pathlib, shutil, subprocess, sys, tempfile, textwrap
+
+# rule -> (pass to run, fixture). Each fixture runs ONLY its own pass
+# (exit 1 is then attributable to it, not to unrelated-pass noise) and
+# must produce a finding line naming the fixture file AND the rule.
+fixtures = {
+    "secret-flow": ("taint",
+                    "def f(journal, dk):\n"
+                    "    journal.append({'p': dk.p})\n"),
+    "lock-order": ("locks", textwrap.dedent("""
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+        def ab():
+            with A:
+                with B: pass
+        def ba():
+            with B:
+                with A: pass
+    """)),
+    "lock-blocking-call": ("locks", textwrap.dedent("""
+        import os, threading
+        L = threading.Lock()
+        def f(fh):
+            with L:
+                os.fsync(fh.fileno())
+    """)),
+    "knob-undeclared": ("knobs",
+                        "import os\n"
+                        "X = os.environ.get('FSDKR_BOGUS_KNOB', '0')\n"),
+}
+tmp = pathlib.Path(tempfile.mkdtemp(prefix="fsdkr_lint_proof_"))
+try:
+    for rule, (passes, src) in fixtures.items():
+        f = tmp / f"planted_{rule.replace('-', '_')}.py"
+        f.write_text(src)
+        p = subprocess.run(
+            [sys.executable, "scripts/fsdkr_lint.py", "--passes", passes,
+             str(f)],
+            capture_output=True, text=True,
+        )
+        assert p.returncode == 1, f"{rule}: gate did not fail\n{p.stdout}{p.stderr}"
+        hit = [ln for ln in p.stdout.splitlines()
+               if ln.startswith(str(f)) and f"[{rule}]" in ln]
+        assert hit, f"{rule} not reported against the fixture:\n{p.stdout}"
+        print(f"gate proof ok: planted {rule} -> exit 1 ({passes} pass)")
+finally:
+    shutil.rmtree(tmp, ignore_errors=True)
+EOF
 
 echo "== test: smoke tier =="
 python -m pytest tests/ -q -m "not slow and not heavy" -p no:cacheprovider

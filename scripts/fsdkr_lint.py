@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""fsdkr-lint driver: the four-pass static-analysis gate (ISSUE 14).
+
+Passes (all by default; select with --passes):
+
+  taint     secret-flow: SECURITY.md's secret carriers must not reach
+            journal/wire/telemetry/LRU/log/JSON sinks unsanitized
+  locks     lock-order cycles + blocking calls under `with <lock>:`
+  knobs     FSDKR_* declaration/README/dead/hot-read drift
+  imports   unused imports + package layering
+
+Inline suppression (reason REQUIRED — residuals stay documented):
+
+    risky_call()  # fsdkr-lint: allow(lock-blocking-call) why it's ok
+
+Usage:
+  python scripts/fsdkr_lint.py [--passes taint,locks] [paths...]
+  (default paths: fsdkr_tpu scripts tests bench.py __graft_entry__.py)
+
+Exit code 1 on any finding — this is the ci.sh analysis gate.
+"""
+
+import argparse
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from fsdkr_tpu.analysis import PASSES, run_passes  # noqa: E402
+
+DEFAULT_PATHS = ["fsdkr_tpu", "scripts", "tests", "bench.py",
+                 "__graft_entry__.py"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: the whole tree)")
+    ap.add_argument("--passes", default=",".join(PASSES),
+                    help=f"comma-separated subset of: {', '.join(PASSES)}")
+    ap.add_argument("--repo-root", default=str(REPO))
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="findings only, no summary line")
+    args = ap.parse_args(argv)
+
+    which = [p.strip() for p in args.passes.split(",") if p.strip()]
+    # explicit paths resolve against the CALLER's cwd (the old
+    # lint_imports contract); only then chdir to the repo root so
+    # in-repo `rel` paths in findings are stable
+    paths = [str(pathlib.Path(p).resolve()) for p in args.paths] \
+        if args.paths else [
+            str(pathlib.Path(args.repo_root) / p) for p in DEFAULT_PATHS
+            if (pathlib.Path(args.repo_root) / p).exists()
+        ]
+    import os
+    os.chdir(args.repo_root)
+
+    try:
+        result = run_passes(
+            paths, which=which, repo_root=args.repo_root,
+            # registry-wide knob reconciliation (dead/undocumented)
+            # needs the whole tree's read surface: only the default
+            # full path set provides it
+            registry_checks=not args.paths,
+        )
+    except FileNotFoundError as e:
+        print(e, file=sys.stderr)
+        return 1
+
+    for f in result["findings"]:
+        print(f)
+    if not args.quiet:
+        print(
+            f"fsdkr-lint: {len(result['findings'])} finding(s), "
+            f"{result['suppressed']} suppressed, "
+            f"{result['files']} files, passes: {', '.join(which)}",
+            file=sys.stderr,
+        )
+    return 1 if result["findings"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,156 +1,22 @@
 #!/usr/bin/env python
-"""Minimal static lint for an image without pyflakes/ruff: flags unused
-imports, per file, via the ast module. Conservative by design —
-`__all__` entries, re-export modules (__init__.py), names starting with
-'_', and names referenced from quoted string annotations are exempt.
-
-Also enforces LAYERING rules (ISSUE 9): `fsdkr_tpu/serving` is an
-orchestration layer and must reach the cryptography only through the
-protocol surface — importing `proofs`, `backend`, `ops`, `native`, or
-`core` internals from serving (absolute or relative) is a finding, so a
-violation fails ci.sh instead of fossilizing.
+"""Back-compat shim (ISSUE 14): the unused-import + layering rules now
+live in the fsdkr-lint framework (`fsdkr_tpu/analysis/imports.py`,
+driver `scripts/fsdkr_lint.py`). This entry point keeps the old CLI —
+same paths, same exit-code contract — and runs exactly the imports
+pass.
 
 Usage: python scripts/lint_imports.py [paths...]   (default: fsdkr_tpu)
-Exit code 1 if any finding (ci.sh lint gate).
+Exit code 1 if any finding.
 """
 
-import ast
-import pathlib
 import sys
 
-# package-dir -> module prefixes its files must not import. Checked for
-# every *.py under the directory, __init__.py included.
-LAYERING_RULES = {
-    "fsdkr_tpu/serving": (
-        "fsdkr_tpu.proofs",
-        "fsdkr_tpu.backend",
-        "fsdkr_tpu.ops",
-        "fsdkr_tpu.native",
-        "fsdkr_tpu.core",
-    ),
-}
+from fsdkr_lint import main as _lint_main
 
 
-def _abs_module(node, path: pathlib.Path):
-    """Absolute dotted module of an ImportFrom, resolving relative
-    imports against the file's package (CPython semantics: __package__
-    is the containing package for BOTH regular modules and __init__.py,
-    and level N strips N-1 trailing components from it)."""
-    if node.level == 0:
-        return node.module or ""
-    parts = path.resolve().parts
-    try:
-        root = parts.index("fsdkr_tpu")
-    except ValueError:
-        return node.module or ""
-    pkg = list(parts[root:-1])  # the module's package path
-    base = pkg[: len(pkg) - (node.level - 1)] if node.level > 1 else pkg
-    return ".".join(base + ([node.module] if node.module else []))
-
-
-def check_layering(path: pathlib.Path, tree) -> list:
-    rel = path.as_posix()
-    rules = [
-        banned
-        for prefix, banned in LAYERING_RULES.items()
-        if f"/{prefix}/" in f"/{rel}" or rel.startswith(prefix + "/")
-    ]
-    if not rules:
-        return []
-    banned = tuple(b for rule in rules for b in rule)
-    findings = []
-    for node in ast.walk(tree):
-        mods = []
-        if isinstance(node, ast.Import):
-            mods = [a.name for a in node.names]
-        elif isinstance(node, ast.ImportFrom):
-            mods = [_abs_module(node, path)]
-        for mod in mods:
-            for b in banned:
-                if mod == b or mod.startswith(b + "."):
-                    findings.append(
-                        f"{path}:{node.lineno}: layering violation: "
-                        f"serving must not import {mod!r} (use the "
-                        f"protocol surface)"
-                    )
-    return findings
-
-
-def check_file(path: pathlib.Path):
-    tree = ast.parse(path.read_text(), filename=str(path))
-    layering = check_layering(path, tree)
-    if path.name == "__init__.py":
-        return layering  # re-export wiring: imports are the point
-
-    exported = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "__all__":
-                    try:
-                        exported = set(ast.literal_eval(node.value))
-                    except ValueError:
-                        pass
-
-    imported = {}  # name -> lineno
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                name = (a.asname or a.name).split(".")[0]
-                imported[name] = node.lineno
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == "__future__":
-                continue  # compiler directives, not names
-            for a in node.names:
-                if a.name == "*":
-                    continue
-                imported[a.asname or a.name] = node.lineno
-
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
-            # quoted annotations ('-> "ProtocolConfig"', TYPE_CHECKING
-            # uses) reference names as strings: count their roots as used
-            try:
-                sub = ast.parse(node.value, mode="eval")
-            except SyntaxError:
-                continue
-            for n in ast.walk(sub):
-                if isinstance(n, ast.Name):
-                    used.add(n.id)
-        elif isinstance(node, ast.Attribute):
-            # record the root of dotted access: jax.numpy -> jax
-            n = node
-            while isinstance(n, ast.Attribute):
-                n = n.value
-            if isinstance(n, ast.Name):
-                used.add(n.id)
-
-    findings = layering
-    for name, lineno in sorted(imported.items(), key=lambda kv: kv[1]):
-        if name in used or name in exported or name.startswith("_"):
-            continue
-        findings.append(f"{path}:{lineno}: unused import {name!r}")
-    return findings
-
-
-def main():
-    roots = [pathlib.Path(p) for p in (sys.argv[1:] or ["fsdkr_tpu"])]
-    findings = []
-    for root in roots:
-        if not root.exists():
-            # a renamed/misspelled root must fail the gate, not silently
-            # shrink its coverage to nothing
-            print(f"lint_imports: no such path: {root}", file=sys.stderr)
-            return 1
-        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
-        for f in files:
-            findings += check_file(f)
-    for line in findings:
-        print(line)
-    return 1 if findings else 0
+def main() -> int:
+    paths = sys.argv[1:] or ["fsdkr_tpu"]
+    return _lint_main(["--passes", "imports", "-q"] + paths)
 
 
 if __name__ == "__main__":

@@ -43,6 +43,22 @@ os.environ.setdefault("FSDKR_DEVICE_POWM", "1")
 # consume-or-compute path is exercised by every protocol test.
 os.environ.setdefault("FSDKR_PRECOMPUTE_BG", "0")
 
+# ISSUE 14: runtime lock-order watchdog. FSDKR_LOCK_CHECK=1 swaps
+# threading.Lock/RLock for order-tracking wrappers BEFORE any fsdkr_tpu
+# module creates its locks (module-level locks are built at import
+# time), validating the static lock graph (scripts/fsdkr_lint.py locks
+# pass) against the orders tier-1 actually executes. Violations stamp
+# the flight recorder like injected faults and fail the session in
+# pytest_sessionfinish below. Off by default everywhere: the
+# bookkeeping costs a dict touch per acquisition on every hot lock.
+_LOCK_CHECK = os.environ.get("FSDKR_LOCK_CHECK", "0").lower() not in (
+    "", "0", "false", "off"
+)
+if _LOCK_CHECK:
+    from fsdkr_tpu.analysis import lockwatch as _lockwatch  # noqa: E402
+
+    _lockwatch.install()
+
 import pytest  # noqa: E402
 
 from fsdkr_tpu.config import TEST_CONFIG  # noqa: E402
@@ -130,6 +146,29 @@ def pytest_configure(config):
         "fresh_committees: bypass the session-scoped keygen cache — every "
         "simulate_keygen call in the test generates a fresh committee",
     )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Under FSDKR_LOCK_CHECK=1 the whole run doubles as a lock-order
+    test: any violation the watchdog observed fails the session, naming
+    the cycle — the same hard-gate posture as the static locks pass."""
+    if not _LOCK_CHECK:
+        return
+    from fsdkr_tpu.analysis import lockwatch
+
+    bad = lockwatch.violations()
+    if bad:
+        import sys as _sys
+
+        print("\nFSDKR_LOCK_CHECK: lock-order violations:", file=_sys.stderr)
+        for v in bad:
+            print(
+                f"  thread {v['thread']}: acquiring {v['acquiring']} "
+                f"while holding {v['held']} (cycle: "
+                + " -> ".join(v["cycle"]) + ")",
+                file=_sys.stderr,
+            )
+        session.exitstatus = 1
 
 
 @pytest.fixture(scope="session")

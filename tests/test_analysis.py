@@ -1,0 +1,429 @@
+"""fsdkr-lint framework tests (ISSUE 14): planted-violation negative
+fixtures (one per rule family, each asserted DETECTED), the clean-tree
+positive run, suppression semantics, the knob registry contract, and
+the FSDKR_LOCK_CHECK runtime watchdog.
+
+The fixtures are the gate's proof obligation: a static-analysis pass
+that cannot catch a planted violation is a green light painted on a
+wall. ci.sh runs the same proof in a subprocess against the real
+driver so the *gate* (exit code) is what's tested there.
+"""
+
+import pathlib
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from fsdkr_tpu.analysis import run_passes  # noqa: E402
+from fsdkr_tpu.analysis import lockwatch  # noqa: E402
+from fsdkr_tpu.analysis.knobs import load_registry  # noqa: E402
+
+
+def _lint(tmp_path, source: str, passes: str, name="fixture_mod.py"):
+    """Write one fixture file and run the selected passes over it."""
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    res = run_passes([str(f)], which=passes.split(","),
+                     repo_root=str(REPO))
+    return res["findings"], res
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# planted violations — one per rule
+
+
+def test_planted_secret_to_journal_detected(tmp_path):
+    findings, _ = _lint(tmp_path, """
+        def settle(journal, dk):
+            journal.append({"t": "terminal", "p": dk.p})
+    """, "taint")
+    assert any(f.rule == "secret-flow" and "journal" in f.message
+               for f in findings), findings
+
+
+def test_planted_secret_to_telemetry_and_log_detected(tmp_path):
+    findings, _ = _lint(tmp_path, """
+        def report(counter, local_key):
+            counter.labels(share=local_key.keys_linear).inc()
+
+        def debug(dks):
+            print("dks are", dks)
+    """, "taint")
+    msgs = [f.message for f in findings if f.rule == "secret-flow"]
+    assert any("telemetry label" in m for m in msgs), msgs
+    assert any("log" in m for m in msgs), msgs
+
+
+def test_planted_secret_to_lru_and_json_detected(tmp_path):
+    findings, _ = _lint(tmp_path, """
+        import json
+
+        def persist(cache, keys):
+            cache.put(("k",), keys[0].paillier_dk, 64)
+
+        def emit(fh, shares):
+            json.dump({"shares": shares}, fh)
+    """, "taint")
+    msgs = [f.message for f in findings if f.rule == "secret-flow"]
+    assert any("public LRU" in m for m in msgs), msgs
+    assert any("JSON emission" in m for m in msgs), msgs
+
+
+def test_sanitized_flow_not_flagged(tmp_path):
+    findings, _ = _lint(tmp_path, """
+        def ok(journal, dk, keys):
+            journal.append({"t": "x", "n": len(keys), "tt": keys[0].t})
+
+        def hashed(counter, local_key):
+            counter.labels(fp=fingerprint(local_key)).inc()
+    """, "taint")
+    assert not findings, findings
+
+
+def test_planted_lock_order_cycle_detected(tmp_path):
+    findings, _ = _lint(tmp_path, """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def ab():
+            with A:
+                with B:
+                    pass
+
+        def ba():
+            with B:
+                with A:
+                    pass
+    """, "locks")
+    assert any(f.rule == "lock-order" for f in findings), findings
+
+
+def test_planted_fsync_under_lock_detected(tmp_path):
+    findings, _ = _lint(tmp_path, """
+        import os
+        import threading
+
+        L = threading.Lock()
+
+        def flush(fh):
+            with L:
+                os.fsync(fh.fileno())
+    """, "locks")
+    assert any(f.rule == "lock-blocking-call" and "fsync" in f.message
+               for f in findings), findings
+
+
+def test_planted_sleep_and_transitive_blocking_detected(tmp_path):
+    findings, _ = _lint(tmp_path, """
+        import time
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _slow(self):
+                time.sleep(1.0)
+
+            def tick(self):
+                with self._lock:
+                    self._slow()
+    """, "locks")
+    assert any(f.rule == "lock-blocking-call" and "sleep" in f.message
+               for f in findings), findings
+
+
+def test_cv_wait_on_held_lock_not_flagged(tmp_path):
+    findings, _ = _lint(tmp_path, """
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+
+            def park(self):
+                with self._cv:
+                    self._cv.wait(timeout=1.0)
+    """, "locks")
+    assert not findings, findings
+
+
+def test_planted_undeclared_knob_detected(tmp_path):
+    findings, _ = _lint(tmp_path, """
+        import os
+
+        FLAG = os.environ.get("FSDKR_NOT_A_REAL_KNOB", "0")
+    """, "knobs")
+    assert any(f.rule == "knob-undeclared" for f in findings), findings
+
+
+def test_planted_hot_loop_env_read_detected(tmp_path):
+    findings, _ = _lint(tmp_path, """
+        import os
+
+        def hot(rows):
+            out = []
+            for r in rows:
+                out.append(r * int(os.environ.get("FSDKR_THREADS", "1")))
+            return out
+    """, "knobs")
+    assert any(f.rule == "knob-hot-read" for f in findings), findings
+
+
+def test_planted_layering_violation_detected(tmp_path):
+    # the serving layering rule keys on the path, so plant the fixture
+    # under a fsdkr_tpu/serving/ directory
+    d = tmp_path / "fsdkr_tpu" / "serving"
+    d.mkdir(parents=True)
+    f = d / "rogue.py"
+    f.write_text("from fsdkr_tpu.backend import rlc\n")
+    res = run_passes([str(f)], which=["imports"], repo_root=str(REPO))
+    assert any(x.rule == "layering" for x in res["findings"]), res
+
+
+def test_planted_unused_import_detected(tmp_path):
+    findings, _ = _lint(tmp_path, """
+        import json
+        import os
+
+        def f():
+            return os.getpid()
+    """, "imports")
+    assert any(f.rule == "unused-import" and "json" in f.message
+               for f in findings), findings
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+
+
+def test_suppression_honored_and_counted(tmp_path):
+    findings, res = _lint(tmp_path, """
+        import os
+        import threading
+
+        L = threading.Lock()
+
+        def flush(fh):
+            with L:
+                os.fsync(fh.fileno())  # fsdkr-lint: allow(lock-blocking-call) fixture residual
+    """, "locks")
+    assert not findings, findings
+    assert res["suppressed"] == 1
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    # the marker is spelled LINTMARK here so the tree-lint of THIS file
+    # does not read the fixture literal as a reasonless suppression
+    src = """
+        import os
+        import threading
+
+        L = threading.Lock()
+
+        def flush(fh):
+            with L:
+                os.fsync(fh.fileno())  # LINTMARK: allow(lock-blocking-call)
+    """.replace("LINTMARK", "fsdkr-lint")
+    findings, _ = _lint(tmp_path, src, "locks")
+    assert any(f.rule == "suppression-missing-reason" for f in findings), \
+        findings
+
+
+def test_suppression_only_covers_named_rule(tmp_path):
+    findings, _ = _lint(tmp_path, """
+        import os
+        import threading
+
+        L = threading.Lock()
+
+        def flush(fh):
+            with L:
+                os.fsync(fh.fileno())  # fsdkr-lint: allow(knob-hot-read) wrong rule on purpose
+    """, "locks")
+    assert any(f.rule == "lock-blocking-call" for f in findings), findings
+
+
+# ---------------------------------------------------------------------------
+# clean tree + gate
+
+
+def test_clean_tree_all_passes():
+    """The tree itself must lint clean — every remaining finding either
+    fixed or carrying a documented in-code suppression (the ISSUE 14
+    acceptance bar)."""
+    res = run_passes(
+        ["fsdkr_tpu", "scripts", "tests", "bench.py", "__graft_entry__.py"],
+        repo_root=str(REPO),
+    )
+    assert not res["findings"], "\n".join(str(f) for f in res["findings"])
+    assert res["files"] > 100  # coverage sanity: the whole tree was read
+
+
+def test_driver_gate_fails_on_planted_violation(tmp_path):
+    f = tmp_path / "bad.py"
+    f.write_text(
+        "def leak(journal, dk):\n"
+        "    journal.append({'p': dk.p})\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "fsdkr_lint.py"),
+         "--passes", "taint", str(f)],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "secret-flow" in proc.stdout
+
+
+def test_driver_fails_on_missing_root():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "fsdkr_lint.py"),
+         "no_such_dir_xyz"],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert proc.returncode == 1  # renamed root must fail, not shrink
+
+
+def test_knob_registry_contract():
+    reg = load_registry(REPO)
+    assert "FSDKR_THREADS" in reg and "FSDKR_LOCK_CHECK" in reg
+    assert all(isinstance(v, str) and v for v in reg.values())
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order watchdog
+
+
+@pytest.fixture
+def clean_watch():
+    """Isolate each watchdog test's planted inversions while PRESERVING
+    any violations earlier tests legitimately recorded — under
+    FSDKR_LOCK_CHECK=1 the sessionfinish gate reads the global list,
+    and a bare reset() here would launder a real session violation."""
+    saved = lockwatch.snapshot_state()
+    lockwatch.reset()
+    yield
+    lockwatch.restore_state(saved)
+
+
+def test_lockwatch_detects_order_inversion(clean_watch):
+    a = lockwatch.make_lock("fix_a.py:1")
+    b = lockwatch.make_lock("fix_b.py:1")
+    with a:
+        with b:
+            pass
+    assert not lockwatch.violations()
+    with b:
+        with a:
+            pass
+    v = lockwatch.violations()
+    assert len(v) == 1, v
+    assert v[0]["held"] == "fix_b.py:1"
+    assert v[0]["acquiring"] == "fix_a.py:1"
+    assert v[0]["cycle"][0] == "fix_a.py:1"
+
+
+def test_lockwatch_transitive_cycle_detected(clean_watch):
+    a = lockwatch.make_lock("t_a.py:1")
+    b = lockwatch.make_lock("t_b.py:1")
+    c = lockwatch.make_lock("t_c.py:1")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with a:  # closes a 3-cycle a->b->c->a
+            pass
+    v = lockwatch.violations()
+    assert len(v) == 1, v
+    assert set(v[0]["cycle"]) == {"t_a.py:1", "t_b.py:1", "t_c.py:1"}
+
+
+def test_lockwatch_same_order_and_reentrant_rlock_clean(clean_watch):
+    a = lockwatch.make_lock("ok_a.py:1")
+    r = lockwatch.make_rlock("ok_r.py:1")
+    for _ in range(3):
+        with a:
+            with r:
+                with r:  # re-entry: no self-edge, no violation
+                    pass
+    assert not lockwatch.violations()
+    assert "ok_a.py:1" in lockwatch.edges()
+
+
+def test_lockwatch_condition_compatible(clean_watch):
+    """threading.Condition over a tracked lock: wait() releases the
+    held entry (so a CV wait can never read as a held-while-acquiring
+    edge), notify wakes it, and no violation is recorded."""
+    lk = lockwatch.make_lock("cv.py:1")
+    cv = threading.Condition(lk)
+    hits = []
+
+    def waiter():
+        with cv:
+            hits.append("waiting")
+            cv.wait(timeout=5.0)
+            hits.append("woken")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    while "waiting" not in hits:
+        pass
+    with cv:
+        cv.notify()
+    t.join(5.0)
+    assert not t.is_alive()
+    assert hits == ["waiting", "woken"]
+    assert not lockwatch.violations()
+
+
+def test_lockwatch_violation_stamps_flight_and_counter(clean_watch):
+    from fsdkr_tpu.telemetry import registry
+
+    base = registry.counter(
+        "fsdkr_lock_order_violations",
+        "runtime lock-order violations (FSDKR_LOCK_CHECK watchdog)",
+    ).value()
+    a = lockwatch.make_lock("st_a.py:1")
+    b = lockwatch.make_lock("st_b.py:1")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert registry.counter(
+        "fsdkr_lock_order_violations",
+        "runtime lock-order violations (FSDKR_LOCK_CHECK watchdog)",
+    ).value() == base + 1
+
+
+def test_lockwatch_tier1_smoke_subprocess():
+    """A tiny pytest selection under FSDKR_LOCK_CHECK=1 completes with
+    zero violations and exercises the install()/sessionfinish wiring
+    end to end (full tier-1 under the knob is the acceptance run)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_journal.py", "-q",
+         "-m", "not slow and not heavy", "-p", "no:cacheprovider"],
+        capture_output=True, text=True, cwd=str(REPO),
+        env={**__import__("os").environ, "FSDKR_LOCK_CHECK": "1",
+             "JAX_PLATFORMS": "cpu"},
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    assert "lock-order violations" not in proc.stderr
