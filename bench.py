@@ -413,6 +413,246 @@ def bench_sessions(sessions_count, n, t, bits, m_sec):
     telemetry_artifacts()
 
 
+def bench_amortization(s_list, n, t, bits, m_sec):
+    """Cross-session amortization curve (ISSUE 17 tentpole (d)): ONE
+    committee, fused collect_sessions launches at S = s_list cloned
+    sessions each. Same-committee sessions are the serving shape the
+    fusion targets (S refresh requests against one broadcast), and the
+    shape where the cross-session machinery all fires: merged fold
+    groups run their full-width ladders once per GROUP per launch (not
+    per session), value-identical pair rows dedup, and the fold-ladder
+    cache (FSDKR_FOLD_CACHE) serves the shared-base comb tables warm
+    after the first two launches. Emits ONE JSON line whose `curve`
+    array carries per-S proofs/s, per-session warm seconds, and the
+    ladders-per-launch accounting the acceptance gate reads
+    (fullwidth_ladders == rlc_groups at every S; S=8 aggregate
+    proofs/s >= 1.3x the S=1 rate)."""
+    import dataclasses
+
+    from fsdkr_tpu.backend import rlc
+    from fsdkr_tpu.config import ProtocolConfig
+    from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+    from fsdkr_tpu.utils.trace import get_tracer
+
+    cfg = ProtocolConfig(paillier_bits=bits, m_security=m_sec)
+    mesh_env = os.environ.get("BENCH_MESH")
+    mesh_shape = (int(mesh_env),) if mesh_env else None
+    tpu_cfg = dataclasses.replace(cfg, backend="tpu", mesh_shape=mesh_shape)
+
+    log(
+        f"amortization sweep S={s_list}: one committee n={n} t={t} "
+        f"bits={bits} M={m_sec} mesh={mesh_shape} ..."
+    )
+    t0 = time.time()
+    keys = simulate_keygen(t, n, cfg)
+    results = RefreshMessage.distribute_batch(
+        [(key.i, key) for key in keys], n, tpu_cfg
+    )
+    msgs = [m for m, _ in results]
+    dks = [dk for _, dk in results]
+    log(f"setup done in {time.time() - t0:.1f}s")
+
+    proofs_per_session = 2 * n * n + 2 * n
+
+    def run(s_count):
+        sessions = [
+            (msgs, keys[0].clone(), dks[0], ()) for _ in range(s_count)
+        ]
+        t0 = time.time()
+        errs = RefreshMessage.collect_sessions(sessions, tpu_cfg)
+        dt = time.time() - t0
+        bad = [i for i, e in enumerate(errs) if e is not None]
+        if bad:
+            raise RuntimeError(f"sessions failed: {bad}: {errs[bad[0]]}")
+        return dt
+
+    # two untimed launches: compiles + the fold-ladder cache's
+    # mark -> build lifecycle, so every timed point below runs warm
+    log(f"warmup launch 1 (cold/mark): {run(1):.2f}s")
+    log(f"warmup launch 2 (table build): {run(1):.2f}s")
+
+    curve = []
+    rate_s1 = None
+    for s_count in s_list:
+        get_tracer().reset(keep_spans=True)
+        rlc.stats_reset()
+        memplan_stats_reset()
+        dt = run(s_count)
+        st = rlc.stats()
+        total_proofs = proofs_per_session * s_count
+        rate = total_proofs / dt
+        if s_count == 1:
+            rate_s1 = rate
+        point = {
+            "sessions": s_count,
+            "collect_warm_s": round(dt, 2),
+            "per_session_warm_s": round(dt / s_count, 3),
+            "proofs_per_s": round(rate, 2),
+            "amortization_x": (
+                round(rate / rate_s1, 3) if rate_s1 else None
+            ),
+            "rlc_groups": st["rlc_groups"],
+            "fullwidth_ladders": st["fullwidth_ladders"],
+            "rows_folded": st["rows_folded"],
+            "xsession_rows_deduped": st["xsession_rows_deduped"],
+            "ladder_cache_hits": st["ladder_cache_hits"],
+            "ladder_cache_misses": st["ladder_cache_misses"],
+        }
+        curve.append(point)
+        log(
+            f"S={s_count}: {dt:.2f}s, {rate:.1f} proofs/s "
+            f"({point['amortization_x']}x vs S=1), ladders "
+            f"{st['fullwidth_ladders']}/{st['rlc_groups']} groups, "
+            f"deduped {st['xsession_rows_deduped']}"
+        )
+        # the amortization claim, checked at every S: full-width
+        # ladders scale with merged groups, never with groups x S
+        assert st["fullwidth_ladders"] == st["rlc_groups"], point
+
+    emit(
+        {
+            "metric": (
+                f"cross-session amortization curve @ n={n},t={t},"
+                f"{bits}-bit,M={m_sec}"
+            ),
+            "value": curve[-1]["proofs_per_s"],
+            "unit": "proofs/s",
+            "vs_baseline": 0,
+            "proofs_per_session": proofs_per_session,
+            "curve": curve,
+            "mesh": mesh_shape,
+            "device_ec": tpu_cfg.device_ec,
+            "device_powm": tpu_cfg.device_powm,
+            **({"degraded": os.environ["BENCH_DEGRADED"]}
+               if os.environ.get("BENCH_DEGRADED") else {}),
+            **telemetry_fields(),
+        }
+    )
+    telemetry_artifacts()
+
+
+def bench_delegate_ab(n, t, bits, m_sec, s_count):
+    """FSDKR_DELEGATE acceptance A/B (ISSUE 17 tentpole (c)): one
+    committee distributed WITH certificates on the wire, then the same
+    fused S-session collect in both knob positions — verdicts and
+    adopted key state must be bit-identical on the honest transcript
+    AND on a tampered one (same exception, both arms), and the
+    delegated arm's MEASURED group ops must sit strictly below the
+    honest arm's op model over the launch's Feldman rows. Emits one
+    JSON line with both counts and the parity verdicts."""
+    import dataclasses
+
+    from fsdkr_tpu.config import ProtocolConfig
+    from fsdkr_tpu.core.secp256k1 import GENERATOR
+    from fsdkr_tpu.proofs import msm_delegate
+    from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+    from fsdkr_tpu.protocol.serialization import local_key_to_json
+
+    cfg = ProtocolConfig(paillier_bits=bits, m_security=m_sec)
+    tpu_cfg = cfg.with_backend("tpu")
+
+    log(
+        f"delegate A/B: n={n} t={t} bits={bits} M={m_sec} "
+        f"S={s_count} fused sessions ..."
+    )
+    os.environ["FSDKR_DELEGATE"] = "1"  # certs on the wire
+    t0 = time.time()
+    keys = simulate_keygen(t, n, cfg)
+    results = RefreshMessage.distribute_batch(
+        [(key.i, key) for key in keys], n, tpu_cfg
+    )
+    msgs = [m for m, _ in results]
+    dks = [dk for _, dk in results]
+    log(f"setup done in {time.time() - t0:.1f}s")
+
+    feld_items = [
+        (msg.coefficients_committed_vec, msg.points_committed_vec[i], i + 1)
+        for _ in range(s_count)
+        for msg in msgs
+        for i in range(n)
+    ]
+    model_ops = msm_delegate.honest_model_ops(feld_items)
+
+    def collect(arm, use_msgs):
+        os.environ["FSDKR_DELEGATE"] = arm
+        sessions = [
+            (use_msgs, keys[0].clone(), dks[0], ()) for _ in range(s_count)
+        ]
+        t0 = time.time()
+        errs = RefreshMessage.collect_sessions(sessions, tpu_cfg)
+        dt = time.time() - t0
+        states = [local_key_to_json(k) for _, k, _, _ in sessions]
+        return errs, states, dt
+
+    collect("0", msgs)  # warmup: compiles + fold-cache mark
+    errs_off, states_off, t_off0 = collect("0", msgs)
+    _, _, t_off = collect("0", msgs)
+    t_off = min(t_off0, t_off)
+    msm_delegate.stats_reset()
+    errs_on, states_on, t_on = collect("1", msgs)
+    dstats = msm_delegate.stats()
+    honest_ok = (
+        errs_off == [None] * s_count
+        and errs_on == [None] * s_count
+        and states_on == states_off
+    )
+    measured = dstats["group_ops"]
+    log(
+        f"honest A/B: off {t_off:.2f}s on {t_on:.2f}s, parity={honest_ok}; "
+        f"delegated ops {measured} vs honest model {model_ops} "
+        f"({dstats['schemes_delegated']} schemes, "
+        f"{dstats['rows_delegated']} rows by certificate)"
+    )
+
+    # tampered transcript: one commitment edited -> both arms must
+    # raise the identical per-session error
+    vss = msgs[1].coefficients_committed_vec
+    bad_commits = list(vss.commitments)
+    bad_commits[0] = bad_commits[0] + GENERATOR
+    msgs_bad = list(msgs)
+    msgs_bad[1] = dataclasses.replace(
+        msgs[1],
+        coefficients_committed_vec=dataclasses.replace(
+            vss, commitments=bad_commits
+        ),
+    )
+    errs_bad_off, _, _ = collect("0", msgs_bad)
+    errs_bad_on, _, _ = collect("1", msgs_bad)
+    tampered_ok = (
+        all(e is not None for e in errs_bad_off)
+        and [type(e) for e in errs_bad_on]
+        == [type(e) for e in errs_bad_off]
+        and [str(e) for e in errs_bad_on] == [str(e) for e in errs_bad_off]
+    )
+    os.environ["FSDKR_DELEGATE"] = "0"
+    log(f"tampered A/B parity={tampered_ok}")
+
+    emit(
+        {
+            "metric": (
+                f"FSDKR_DELEGATE A/B @ n={n},t={t},{bits}-bit,"
+                f"S={s_count} fused sessions"
+            ),
+            "value": measured,
+            "unit": "delegated group ops (honest model "
+                    f"{model_ops})",
+            "vs_baseline": 0,
+            "honest_model_ops": model_ops,
+            "delegated_measured_ops": measured,
+            "ops_ratio": round(measured / model_ops, 3) if model_ops else None,
+            "verdict_parity_honest": honest_ok,
+            "verdict_parity_tampered": tampered_ok,
+            "collect_warm_honest_s": round(t_off, 2),
+            "collect_warm_delegated_s": round(t_on, 2),
+            "sessions": s_count,
+            "delegate": dstats,
+            **({"degraded": os.environ["BENCH_DEGRADED"]}
+               if os.environ.get("BENCH_DEGRADED") else {}),
+        }
+    )
+    telemetry_artifacts()
+
+
 def bench_join(n, t, bits, m_sec, joins):
     """Config-3 shape (BASELINE.json): join/replace at (n, t) — ring-
     Pedersen + PDL batches plus the join-side correct-key/composite-dlog
@@ -517,6 +757,18 @@ def main():
     from fsdkr_tpu.config import ProtocolConfig
     from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
 
+    amortize = os.environ.get("BENCH_AMORTIZE")
+    if amortize:
+        bench_amortization(
+            [int(x) for x in amortize.split(",") if x.strip()],
+            n, t, bits, m_sec,
+        )
+        return
+    if os.environ.get("BENCH_DELEGATE_AB") == "1":
+        bench_delegate_ab(
+            n, t, bits, m_sec, sessions_count if sessions_count > 1 else 4
+        )
+        return
     if sessions_count > 1:
         bench_sessions(sessions_count, n, t, bits, m_sec)
         return

@@ -37,11 +37,14 @@ class BatchVerifier:
     ) -> List[bool]:
         raise NotImplementedError
 
-    def verify_pairs(self, pdl_items, range_items):
+    def verify_pairs(self, pdl_items, range_items, session_spans=None):
         """Both families of the O(n^2) pair loop
         (`src/refresh_message.rs:330-350`). Default: two family calls;
         the TPU backend overrides to share one fused launch set, which
-        matters when small batches underfeed the chip."""
+        matters when small batches underfeed the chip. `session_spans`
+        (session -> [lo, hi) row span of a fused multi-session launch)
+        is advisory: the base implementation's verdicts are already
+        per-row exact, so it is accepted and ignored here."""
         return self.verify_pdl(pdl_items), self.verify_range(range_items)
 
     def verify_ring_pedersen(
@@ -109,6 +112,28 @@ class HostBatchVerifier(BatchVerifier):
         return [proof.verify(st, hash_alg=self._hash_alg) for proof, st in items]
 
     def validate_feldman(self, items):
+        """Feldman share validation, with the FSDKR_DELEGATE certificate
+        pre-pass (proofs.msm_delegate): rows of a scheme whose
+        broadcast certificate checks out are resolved without any
+        per-row MSM; everything else (arm disabled, no/failing cert,
+        partial coverage) takes the honest native-Horner/per-row path
+        below — verdicts bit-identical in both knob positions."""
+        from ..proofs import msm_delegate
+
+        pre = msm_delegate.try_delegate(items, self._hash_alg)
+        if pre is not None:
+            remaining = [i for i, v in enumerate(pre) if v is None]
+            if not remaining:
+                return [bool(v) for v in pre]
+            sub = self._validate_feldman_honest(
+                [items[i] for i in remaining]
+            )
+            for i, v in zip(remaining, sub):
+                pre[i] = v
+            return pre
+        return self._validate_feldman_honest(items)
+
+    def _validate_feldman_honest(self, items):
         from ..native import ec as native_ec
 
         if not native_ec.available() or not items:

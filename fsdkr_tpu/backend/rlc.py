@@ -48,8 +48,10 @@ from typing import Callable, Dict, List, Sequence
 __all__ = [
     "RLC_BITS",
     "rlc_enabled",
+    "xsession_dedup_enabled",
     "sample_rhos",
     "bisect_rows",
+    "bisect_sessions",
     "StreamFold",
     "stats",
     "stats_reset",
@@ -64,6 +66,19 @@ def rlc_enabled() -> bool:
     reverts the verifier to the per-row column/joint path. Read at call
     time so the bench battery and the CI legs can toggle it per step."""
     return os.environ.get("FSDKR_RLC", "1").lower() not in (
+        "0", "off", "false", "no",
+    )
+
+
+def xsession_dedup_enabled() -> bool:
+    """FSDKR_XSESSION_DEDUP gates cross-session value dedup in fused
+    multi-session launches (tpu_verifier.verify_pairs): same-committee
+    sessions produce value-identical pair rows, so one representative
+    per distinct row value is verified and its verdict fanned out. =0
+    verifies every row of the fused batch for A/B isolation. Read at
+    call time so the bench battery and the CI legs can toggle it per
+    step."""
+    return os.environ.get("FSDKR_XSESSION_DEDUP", "1").lower() not in (
         "0", "off", "false", "no",
     )
 
@@ -88,7 +103,8 @@ def sample_rhos(count: int) -> List[int]:
 
 _EVENTS = (
     "rlc_groups", "rows_folded", "fullwidth_ladders", "bisect_fallbacks",
-    "stream_tiles",
+    "stream_tiles", "session_bisects", "ladder_cache_hits",
+    "ladder_cache_misses", "xsession_rows_deduped",
 )
 
 
@@ -194,4 +210,40 @@ def bisect_rows(
                     out[i] = True
             else:
                 stack.append(half)
+    return out
+
+
+def bisect_sessions(
+    indices: Sequence[int],
+    session_of: Callable[[int], int],
+    combined_check: Callable[[List[int]], bool],
+    row_check: Callable[[int], bool],
+    leaf: int = 2,
+) -> Dict[int, bool]:
+    """`bisect_rows` with a session-first split for groups whose rows
+    were merged across fused sessions: partition the failing group's
+    rows by owning session (absorption order preserved within each),
+    combined-check each session's subset once, and only bisect WITHIN
+    the sessions whose subset fails. An honest session fused with a
+    tampered sibling is therefore cleared by one combined sub-check —
+    never blamed, never even row-checked — so fusion can only *sharpen*
+    attribution cost, and verdicts stay bit-identical to S independent
+    collects (each session's rows are decided by exactly the shared
+    `bisect_rows`/`row_check` machinery an unfused collect would use).
+
+    With rows from <= 1 distinct session the partition is a no-op and
+    this degrades to plain `bisect_rows` (no extra combined check)."""
+    by_session: Dict[int, List[int]] = {}
+    for i in indices:
+        by_session.setdefault(session_of(i), []).append(i)
+    if len(by_session) <= 1:
+        return bisect_rows(indices, combined_check, row_check, leaf)
+    out: Dict[int, bool] = {}
+    for rows in by_session.values():
+        count("session_bisects")
+        if combined_check(rows):
+            for i in rows:
+                out[i] = True
+        else:
+            out.update(bisect_rows(rows, combined_check, row_check, leaf))
     return out

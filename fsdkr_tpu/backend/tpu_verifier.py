@@ -161,11 +161,14 @@ class TpuBatchVerifier(BatchVerifier):
         cols = nt_cols + (multi,)
         return cols, (e_vec, nn_mod, nt_mod, row_ok, inv_fail)
 
-    def _pdl_finish(self, items, state, results, u1_vec=None):
+    def _pdl_finish(self, items, state, results, u1_vec=None,
+                    session_of=None):
         """Combine the modexp column results into per-row verdicts.
         u1_vec carries the EC u1 column when the caller overlapped it
         with the modexp launches (pipeline mode); None computes it here
-        (the pdl.ec_u1 phase then measures compute, not just the join)."""
+        (the pdl.ec_u1 phase then measures compute, not just the join).
+        session_of is accepted for signature parity with the RLC finish
+        and ignored: column verdicts are already exact per row."""
         e_vec, nn_mod, nt_mod, row_ok, inv_fail = state
         with phase("pdl.combine", items=len(items)):
             gs1 = [
@@ -258,16 +261,21 @@ class TpuBatchVerifier(BatchVerifier):
         mb: list = []
         me: list = []
         mm: list = []
-        nt_plan = []  # (row indices, lhs position, rhs position)
+        nt_plan = []  # (row indices, lhs slot in nt_lhs, rhs position)
+        nt_lhs = []  # merged 2-term (h1,h2) ladder rows -> fold_ladder2
         for (h1, h2, nt), idxs in nt_groups.items():
             rho = rlc.sample_rhos(len(idxs))
             rows = self._pdl_nt_rows(items, e_vec, idxs)
             lhs, rhs = PDLwSlackProof.rlc_fold_nt(h1, h2, nt, rows, rho)
-            nt_plan.append((idxs, len(mm), len(mm) + 1))
-            for b, e, m in (lhs, rhs):
-                mb.append(b)
-                me.append(e)
-                mm.append(m)
+            # the lhs is the group's ONE merged shared-base ladder
+            # (h1^S1 * h2^S3): it runs through the cross-launch
+            # fold-ladder cache (powm.fold_ladder2) instead of the joint
+            # column, so warm shards skip its full-width squaring chain
+            nt_plan.append((idxs, len(nt_lhs), len(mm)))
+            nt_lhs.append(lhs)
+            mb.append(rhs[0])
+            me.append(rhs[1])
+            mm.append(rhs[2])
         nn_plan = []  # (row indices, n, nn, gs1, s2 position, commit position)
         for (n, nn), idxs in nn_groups.items():
             rho = rlc.sample_rhos(len(idxs))
@@ -288,7 +296,7 @@ class TpuBatchVerifier(BatchVerifier):
         # eq3's merged h1/h2 2-term ladder + eq2's phase-2 A^n: one
         # full-width squaring chain per group, down from one per row
         rlc.count("fullwidth_ladders", len(nt_plan) + len(nn_plan))
-        return ((mb, me, mm),), (e_vec, row_ok, nt_plan, nn_plan)
+        return ((mb, me, mm),), (e_vec, row_ok, nt_plan, nn_plan, nt_lhs)
 
     def _pdl_eq3_exact(self, items, e_vec, i) -> bool:
         """Column-form mod-N~ equality for exactly row i (bisection
@@ -386,52 +394,68 @@ class TpuBatchVerifier(BatchVerifier):
         )
         return cv == g1 * intops.mod_pow(av, n, nn) % nn
 
-    def _pdl_nt_bisect(self, items, e_vec, h1, h2, nt, idxs, ok3_vec):
+    def _pdl_nt_bisect(
+        self, items, e_vec, h1, h2, nt, idxs, ok3_vec, session_of=None
+    ):
         from . import rlc
 
         rlc.count("bisect_fallbacks")
-        verdicts = rlc.bisect_rows(
-            idxs,
-            lambda sub: self._pdl_nt_subset_check(
-                items, e_vec, h1, h2, nt, sub
-            ),
-            lambda i: self._pdl_eq3_exact(items, e_vec, i),
+        combined = lambda sub: self._pdl_nt_subset_check(  # noqa: E731
+            items, e_vec, h1, h2, nt, sub
+        )
+        exact = lambda i: self._pdl_eq3_exact(items, e_vec, i)  # noqa: E731
+        verdicts = (
+            rlc.bisect_sessions(idxs, session_of, combined, exact)
+            if session_of is not None
+            else rlc.bisect_rows(idxs, combined, exact)
         )
         for i, v in verdicts.items():
             ok3_vec[i] = v
 
-    def _pdl_nn_bisect(self, items, e_vec, n, nn, idxs, ok2_vec):
+    def _pdl_nn_bisect(
+        self, items, e_vec, n, nn, idxs, ok2_vec, session_of=None
+    ):
         from . import rlc
 
         rlc.count("bisect_fallbacks")
-        verdicts = rlc.bisect_rows(
-            idxs,
-            lambda sub: self._pdl_nn_subset_check(
-                items, e_vec, n, nn, sub
-            ),
-            lambda i: self._pdl_eq2_exact(items, e_vec, i),
+        combined = lambda sub: self._pdl_nn_subset_check(  # noqa: E731
+            items, e_vec, n, nn, sub
+        )
+        exact = lambda i: self._pdl_eq2_exact(items, e_vec, i)  # noqa: E731
+        verdicts = (
+            rlc.bisect_sessions(idxs, session_of, combined, exact)
+            if session_of is not None
+            else rlc.bisect_rows(idxs, combined, exact)
         )
         for i, v in verdicts.items():
             ok2_vec[i] = v
 
-    def _pdl_rlc_finish(self, items, state, results, u1_vec=None):
+    def _pdl_rlc_finish(
+        self, items, state, results, u1_vec=None, session_of=None
+    ):
         """Compare each group's folded equation, bisect failing groups
-        down to exact per-row verdicts (backend.rlc.bisect_rows), and
-        assemble the same (u1, u2, u3) triples as _pdl_finish."""
-        e_vec, row_ok, nt_plan, nn_plan = state
+        down to exact per-row verdicts (backend.rlc.bisect_rows — or
+        session-first via bisect_sessions when the rows were merged
+        across fused sessions), and assemble the same (u1, u2, u3)
+        triples as _pdl_finish."""
+        from .powm import fold_ladder2
+
+        e_vec, row_ok, nt_plan, nn_plan, nt_lhs = state
         multi_res = results[0]
         ok2_vec = [False] * len(items)
         ok3_vec = [False] * len(items)
 
         with phase("pdl.rlc_eq3", items=sum(len(g[0]) for g in nt_plan)):
-            for idxs, lhs_pos, rhs_pos in nt_plan:
-                if multi_res[lhs_pos] == multi_res[rhs_pos]:
+            lhs_vals = fold_ladder2(nt_lhs)
+            for idxs, lhs_slot, rhs_pos in nt_plan:
+                if lhs_vals[lhs_slot] == multi_res[rhs_pos]:
                     for i in idxs:
                         ok3_vec[i] = True
                     continue
                 st0 = items[idxs[0]][1]
                 self._pdl_nt_bisect(
-                    items, e_vec, st0.h1, st0.h2, st0.N_tilde, idxs, ok3_vec
+                    items, e_vec, st0.h1, st0.h2, st0.N_tilde, idxs,
+                    ok3_vec, session_of=session_of,
                 )
 
         with phase("pdl.rlc_eq2", items=sum(len(g[0]) for g in nn_plan)):
@@ -449,7 +473,10 @@ class TpuBatchVerifier(BatchVerifier):
                     for i in idxs:
                         ok2_vec[i] = True
                     continue
-                self._pdl_nn_bisect(items, e_vec, n, nn, idxs, ok2_vec)
+                self._pdl_nn_bisect(
+                    items, e_vec, n, nn, idxs, ok2_vec,
+                    session_of=session_of,
+                )
 
         with phase("pdl.ec_u1", items=len(items)):
             ok1_vec = (
@@ -899,9 +926,20 @@ class TpuBatchVerifier(BatchVerifier):
             results = powm_columns(_modexp, *cols)
         return self._range_finish(items, mods, results)
 
-    def verify_pairs(self, pdl_items, range_items):
+    def verify_pairs(self, pdl_items, range_items, session_spans=None):
         """Both pair-loop families of a collect. Dispatch:
 
+        - A fused multi-session launch (`session_spans` maps session ->
+          [lo, hi) row span; refresh.collect_sessions and
+          streaming.finalize_streams pass it) first runs cross-session
+          value dedup (FSDKR_XSESSION_DEDUP): same-committee sessions
+          produce VALUE-IDENTICAL (proof, statement) row pairs, so one
+          representative per distinct row value is verified and its
+          verdict fanned out — the fused batch collapses to ~one
+          session's size. Residual distinct rows keep per-session
+          attribution: failing merged RLC groups bisect session-first
+          (rlc.bisect_sessions), so blame stays bit-identical to S
+          independent collects.
         - Under the bytes-budgeted memory plan (FSDKR_MEM_PLAN, default
           on) a batch whose estimated staged bytes exceed
           FSDKR_MEM_BUDGET_MB runs tile-by-tile through
@@ -912,10 +950,21 @@ class TpuBatchVerifier(BatchVerifier):
           take the monolithic single-launch-set path unchanged.
 
         Verdicts and identifiable-abort blame are bit-identical between
-        the two (tests/test_memplan.py, every budget down to 1-row
-        tiles)."""
+        all paths (tests/test_memplan.py, tests/test_xsession.py)."""
         if not pdl_items or not range_items:
             return super().verify_pairs(pdl_items, range_items)
+        from .rlc import xsession_dedup_enabled
+
+        if (
+            session_spans is not None
+            and len(session_spans) > 1
+            and len(pdl_items) == len(range_items)
+            and xsession_dedup_enabled()
+        ):
+            ded = self._xsession_dedup(pdl_items, range_items)
+            if ded is not None:
+                return ded
+        session_of = self._session_of(session_spans, len(pdl_items))
         if len(pdl_items) == len(range_items):
             # the streamed driver slices BOTH families with one row
             # axis; unequal lists (not produced by any collect path,
@@ -923,9 +972,68 @@ class TpuBatchVerifier(BatchVerifier):
             plan = self._pair_plan(pdl_items)
             if plan is not None and plan.multi_tile:
                 return self._verify_pairs_streamed(
-                    pdl_items, range_items, plan
+                    pdl_items, range_items, plan, session_of=session_of
                 )
-        return self._verify_pairs_monolithic(pdl_items, range_items)
+        return self._verify_pairs_monolithic(
+            pdl_items, range_items, session_of=session_of
+        )
+
+    @staticmethod
+    def _session_of(session_spans, n_rows):
+        """Row index -> owning session callable (None when the launch
+        has no cross-session structure to exploit)."""
+        if not session_spans or len(session_spans) <= 1:
+            return None
+        owner = [0] * n_rows
+        for s, (lo, hi) in session_spans.items():
+            for i in range(lo, hi):
+                owner[i] = s
+        return owner.__getitem__
+
+    def _xsession_dedup(self, pdl_items, range_items):
+        """Fuse value-identical rows across sessions: every component of
+        a pair row — PDLwSlackProof/Statement, AliceProof, EncryptionKey,
+        DLogStatement — is a frozen dataclass over ints/Points, so the
+        (pdl_row, range_row) pair itself is the value key, covering
+        EVERY input the row's verdict depends on (verdicts are
+        deterministic functions of row values up to the RLC soundness
+        coin, and a row is only ever marked INVALID through its exact
+        per-row check — so fanning a representative's verdict out to its
+        duplicates is exact, not approximate). Returns None when the
+        sessions share nothing (distinct committees): the caller then
+        runs the fused path with session-first blame instead."""
+        from . import rlc
+
+        first: Dict[tuple, int] = {}
+        rep_idx: List[int] = []
+        owners: List[List[int]] = []
+        for i, row in enumerate(zip(pdl_items, range_items)):
+            j = first.get(row)
+            if j is None:
+                first[row] = len(rep_idx)
+                rep_idx.append(i)
+                owners.append([i])
+            else:
+                owners[j].append(i)
+        if len(rep_idx) == len(pdl_items):
+            return None
+        rlc.count("xsession_rows_deduped", len(pdl_items) - len(rep_idx))
+        with phase(
+            "pairs.xsession_dedup",
+            items=len(pdl_items),
+            unique=len(rep_idx),
+        ):
+            p_u, r_u = self.verify_pairs(
+                [pdl_items[i] for i in rep_idx],
+                [range_items[i] for i in rep_idx],
+            )
+        pdl_out = [None] * len(pdl_items)
+        range_out = [False] * len(range_items)
+        for j, dup_rows in enumerate(owners):
+            for i in dup_rows:
+                pdl_out[i] = p_u[j]
+                range_out[i] = r_u[j]
+        return pdl_out, range_out
 
     def _pair_plan(self, pdl_items):
         """Tile plan for a pair batch. The widths feeding the row-bytes
@@ -945,7 +1053,9 @@ class TpuBatchVerifier(BatchVerifier):
             label="pairs",
         )
 
-    def _verify_pairs_streamed(self, pdl_items, range_items, plan):
+    def _verify_pairs_streamed(
+        self, pdl_items, range_items, plan, session_of=None
+    ):
         """Memory-planned pair verification: the row axis runs as
         budget-sized tiles (mesh-aligned cuts, backend.memplan), each
         tile built -> staged -> verified -> wiped before the next is
@@ -968,6 +1078,7 @@ class TpuBatchVerifier(BatchVerifier):
         from ..utils.pipeline import prefetch_tiles, run_jobs
         from . import memplan, rlc
         from .powm import (
+            fold_ladder2,
             multi_powm,
             multiexp_enabled,
             powm_columns,
@@ -1139,10 +1250,11 @@ class TpuBatchVerifier(BatchVerifier):
         ):
             groups = list(nt_folds.items())
             if groups:
-                lhs_vals = multi_powm(
-                    [(h1, h2) for (h1, h2, _nt), _ in groups],
-                    [tuple(f.exp_sums) for _, f in groups],
-                    [nt for (_h1, _h2, nt), _ in groups],
+                lhs_vals = fold_ladder2(
+                    [
+                        ((h1, h2), tuple(f.exp_sums), nt)
+                        for (h1, h2, nt), f in groups
+                    ]
                 )
                 for ((h1, h2, nt), fold), lv in zip(groups, lhs_vals):
                     if lv == fold.prods[0]:
@@ -1151,7 +1263,7 @@ class TpuBatchVerifier(BatchVerifier):
                     else:
                         self._pdl_nt_bisect(
                             pdl_items, e_vec, h1, h2, nt, fold.rows,
-                            ok3_vec,
+                            ok3_vec, session_of=session_of,
                         )
         with phase(
             "pdl.rlc_eq2",
@@ -1171,7 +1283,8 @@ class TpuBatchVerifier(BatchVerifier):
                             ok2_vec[i] = True
                     else:
                         self._pdl_nn_bisect(
-                            pdl_items, e_vec, n, nn, fold.rows, ok2_vec
+                            pdl_items, e_vec, n, nn, fold.rows, ok2_vec,
+                            session_of=session_of,
                         )
 
         out = []
@@ -1182,7 +1295,9 @@ class TpuBatchVerifier(BatchVerifier):
             out.append(None if (ok1 and ok2 and ok3) else (ok1, ok2, ok3))
         return out, range_out
 
-    def _verify_pairs_monolithic(self, pdl_items, range_items):
+    def _verify_pairs_monolithic(
+        self, pdl_items, range_items, session_of=None
+    ):
         """Both pair-loop families through ONE fused launch set: every
         modexp column submitted together, so same-width columns across
         families share launches (e.g. both 256-bit challenge columns) —
@@ -1237,6 +1352,7 @@ class TpuBatchVerifier(BatchVerifier):
                 pdl_finish(
                     pdl_items, state, presults[0],
                     u1_vec=u1_fut.result() if u1_fut is not None else None,
+                    session_of=session_of,
                 ),
                 self._range_opt_finish(range_items, rstate),
             )
@@ -1248,6 +1364,7 @@ class TpuBatchVerifier(BatchVerifier):
             pdl_finish(
                 pdl_items, state, results[: len(pcols)],
                 u1_vec=u1_fut.result() if u1_fut is not None else None,
+                session_of=session_of,
             ),
             self._range_finish(range_items, rmods, results[len(pcols) :]),
         )
@@ -1582,14 +1699,34 @@ class TpuBatchVerifier(BatchVerifier):
             sum_u rho_u*S_u + sum_k (-sum_u rho_u u^k)*A_k == identity
         (the inner scalar sums are cheap host int math); per-row host
         fallback only for the rows of a failing scheme."""
-        import secrets as _secrets
-
-        from ..ops.ec_batch import batch_msm
-
         if not items:
             return []
         if not self.config.device_ec:  # see _pdl_u1_batch routing note
             return self._host.validate_feldman(items)
+        # FSDKR_DELEGATE certificate pre-pass (proofs.msm_delegate):
+        # schemes with an accepted broadcast certificate skip the device
+        # MSM entirely; unresolved rows take the device path below. The
+        # host route above runs the same pre-pass inside
+        # HostBatchVerifier.validate_feldman.
+        from ..proofs import msm_delegate
+
+        pre = msm_delegate.try_delegate(items, self.config.hash_alg)
+        if pre is not None:
+            remaining = [i for i, v in enumerate(pre) if v is None]
+            if not remaining:
+                return [bool(v) for v in pre]
+            sub = self._validate_feldman_device(
+                [items[i] for i in remaining]
+            )
+            for i, v in zip(remaining, sub):
+                pre[i] = v
+            return pre
+        return self._validate_feldman_device(items)
+
+    def _validate_feldman_device(self, items):
+        import secrets as _secrets
+
+        from ..ops.ec_batch import batch_msm
 
         groups: Dict[int, List[int]] = {}
         for row, (scheme, _, _) in enumerate(items):
@@ -1626,7 +1763,9 @@ class TpuBatchVerifier(BatchVerifier):
                 for row in rows:
                     out[row] = True
             else:
-                verdicts = self._host.validate_feldman(
+                # honest per-row resolution (not the host's public
+                # validate_feldman: the delegate pre-pass already ran)
+                verdicts = self._host._validate_feldman_honest(
                     [items[row] for row in rows]
                 )
                 for row, v in zip(rows, verdicts):

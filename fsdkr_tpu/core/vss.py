@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import secrets
 from dataclasses import dataclass, field
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 from .secp256k1 import GENERATOR, N, Point, Scalar
 
@@ -34,10 +34,18 @@ class ShamirSecretSharing:
 @dataclass
 class VerifiableSS:
     """A Feldman VSS instance: parameters + commitments A_k = a_k * G to the
-    t+1 polynomial coefficients."""
+    t+1 polynomial coefficients.
+
+    `delegate_cert` is the optional 2G2T-style MSM-delegation certificate
+    (proofs.msm_delegate, FSDKR_DELEGATE): one broadcast-public point
+    R = (sum_u rho_u f(u)) * G emitted by the dealer so verifiers can
+    check the certificate instead of computing the per-share Horner
+    MSMs. None (the default, and the wire default — the serialization
+    omits the key entirely) means the honest per-row path."""
 
     parameters: ShamirSecretSharing
     commitments: List[Point] = field(default_factory=list)
+    delegate_cert: Optional[Point] = None
 
     def validate_share_public(self, public_share: Point, index: int) -> bool:
         """Check sum_k A_k * index^k == public_share

@@ -36,6 +36,9 @@ KNOBS = {
     "FSDKR_DEVICE_POWM": "device batched modexp routing (auto/1/0)",
     "FSDKR_PALLAS": "fused Pallas MontMul kernels (auto/1/0)",
     "FSDKR_NO_PALLAS": "bench-side hard disable of Pallas probes (1/0)",
+    "FSDKR_XSESSION_DEDUP": "cross-session pair-row value dedup (1/0)",
+    "FSDKR_FOLD_CACHE": "cross-launch shared-base fold-ladder cache (1/0)",
+    "FSDKR_DELEGATE": "Feldman-MSM delegation certificate arm (0/1)",
     # -- sizing / tuning ----------------------------------------------------
     "FSDKR_THREADS": "native row-pool worker threads (auto/int)",
     "FSDKR_TILE_ROWS": "native-path tile size in rows (0 = whole batch)",

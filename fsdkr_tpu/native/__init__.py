@@ -493,6 +493,8 @@ def comb2_apply(
     base2: int,
     exps2: Sequence[int],
     mod: int,
+    stats_out: Optional[dict] = None,
+    min_exp_limbs: int = 0,
 ) -> Optional[List[int]]:
     """outs[m] = base1^exps1[m] * base2^exps2[m] mod mod in ONE native
     pass over both bases' persistent comb window tables (the h1^s1 *
@@ -502,7 +504,14 @@ def comb2_apply(
     public-base LRU, so warm epochs of a stable committee skip every
     build. PUBLIC bases only (cache-key contract of _cached_comb_table);
     returns None when the native core, the cache, or the geometry is
-    unavailable — callers fall back to the split comb columns."""
+    unavailable — callers fall back to the split comb columns.
+
+    `stats_out`, when a dict, receives ``cached=True`` iff BOTH tables
+    were already resident before this call (the fold-ladder cache counts
+    warm applies vs builds from it, via a no-side-effect peek).
+    `min_exp_limbs` > 0 floors the exponent limb width AND opts into
+    width-tolerant table reuse (see the _resolve comment below) for
+    callers whose exponent widths jitter launch-to-launch."""
     if not exps1:
         return []
     if len(exps1) != len(exps2):
@@ -518,8 +527,8 @@ def comb2_apply(
         or any(e < 0 for e in exps2)
     ):
         return None
-    EL1 = max(1, max(_limbs_for(e) for e in exps1))
-    EL2 = max(1, max(_limbs_for(e) for e in exps2))
+    EL1 = max(1, min_exp_limbs, max(_limbs_for(e) for e in exps1))
+    EL2 = max(1, min_exp_limbs, max(_limbs_for(e) for e in exps2))
     if max(EL1, EL2) > 2 * _MAX_LIMBS:
         return None
     _LIB.sync_threads()
@@ -529,6 +538,7 @@ def comb2_apply(
     if budget <= 0:
         return None  # persistent tables are the point of this engine
     m_rows = len(exps1)
+
     # reuse=16: these tables back every warm verify_pairs of a stable
     # committee, so the optimizer leans toward apply cost (wider
     # windows). When that picks a different wbits than modexp_shared's
@@ -536,8 +546,46 @@ def comb2_apply(
     # FSDKR_RANGEOPT A/B toggle inside one process — the LRU holds one
     # table per geometry key, so both paths stay correct at the price of
     # a second build; in a single-policy process only one exists.
-    w1 = _comb_window_bits_cached(EL1 * 64, m_rows, L, budget, reuse=16)
-    w2 = _comb_window_bits_cached(EL2 * 64, m_rows, L, budget, reuse=16)
+    def _wbits(el: int) -> int:
+        return _comb_window_bits_cached(el * 64, m_rows, L, budget, reuse=16)
+
+    if min_exp_limbs:
+        # Width-tolerant table resolution (the fold-ladder cache's
+        # contract, min_exp_limbs > 0): the caller's exponents are
+        # random linear-combination sums whose NATURAL limb width
+        # jitters launch-to-launch around the committee's value-width
+        # center (e.g. 14 <-> 15 limbs), and an exact-EL key would fork
+        # the table per jitter and never go warm. A table built for a
+        # wider EL evaluates narrower exponents exactly (leading zero
+        # windows), so: reuse any resident table within +4 limbs of the
+        # natural width, and on miss build with +2 limbs of slack so
+        # every +-1-jittered future launch lands inside the window.
+        def _resolve(base_red: int, el_nat: int):
+            cache = global_cache()
+            hi = min(el_nat + 4, 2 * _MAX_LIMBS)
+            for cand in range(el_nat, hi + 1):
+                wc = _wbits(cand)
+                key = ("native-comb", base_red, mod, cand, wc)
+                if cache.peek(key) is not None:
+                    return cand, wc, True
+            cand = min(el_nat + 2, 2 * _MAX_LIMBS)
+            return cand, _wbits(cand), False
+
+        EL1, w1, hit1 = _resolve(base1 % mod, EL1)
+        EL2, w2, hit2 = _resolve(base2 % mod, EL2)
+        if stats_out is not None:
+            stats_out["cached"] = hit1 and hit2
+    else:
+        w1 = _wbits(EL1)
+        w2 = _wbits(EL2)
+        if stats_out is not None:
+            cache = global_cache()
+            stats_out["cached"] = (
+                cache.peek(("native-comb", base1 % mod, mod, EL1, w1))
+                is not None
+                and cache.peek(("native-comb", base2 % mod, mod, EL2, w2))
+                is not None
+            )
     t1 = _cached_comb_table(lib, base1 % mod, mod, L, EL1, w1)
     t2 = _cached_comb_table(lib, base2 % mod, mod, L, EL2, w2)
     if t1 is None or t2 is None:

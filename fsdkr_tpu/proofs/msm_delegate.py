@@ -1,0 +1,314 @@
+"""2G2T-style constant-size MSM delegation for the Feldman/EC column
+(FSDKR_DELEGATE, arXiv:2602.23464 prototype; ISSUE 17 tentpole (c)).
+
+The honest Feldman verifier evaluates sum_k A_k * u^k == S_u per share
+row — n Horner chains of t small-scalar muls per scheme, the EC work a
+loaded shard pays on every collect. Delegation moves the bulk of that
+work to the prover: at distribute, the sender emits ONE extra point per
+scheme, the certificate
+
+    R = (sum_u rho_u * f(u) mod q) * G,
+
+with Fiat-Shamir coefficients rho_u = H(domain, A_0..A_t, S_1..S_n, u)
+at RHO_BITS = 64 statistical bits (nonzero by construction). The
+verifier then checks the certificate instead of computing the per-row
+MSMs:
+
+    S-side:  sum_u rho_u * S_u           == R   (n points, 64-bit scalars)
+    A-side:  sum_k c_k  * A_k            == R   (t+1 points, c_k integer)
+
+with c_k = sum_u rho_u * u^k kept as PLAIN integers (~RHO_BITS +
+t*log2(n) bits — never reduced mod q; the narrow scalars are the whole
+advantage). Honest transcripts satisfy both sides identically
+(S_u = sum_k u^k A_k implies sum_u rho_u S_u = sum_k c_k A_k), so a
+correct certificate resolves every row of the scheme with TWO shared
+doubling chains — and, crucially, resolves them ONCE per scheme no
+matter how many fused sessions carry the same broadcast: try_delegate
+groups rows by scheme identity, so an S-session launch pays one
+certificate check where the honest arm pays S x n Horner chains. That
+cross-session amortization is where the op-count win lives (measured
+by a real op counter on the shared-chain wNAF MSM below; the
+acceptance A/B publishes both counts): at a single n=16, t=8 session
+the honest arm's tiny <=4-bit Horner scalars make delegation a near
+wash, and the delegated count drops strictly below the honest model
+from S >= 2 fused sessions (or single sessions with n >= ~32).
+
+Soundness is STATISTICAL at the prototype parameter RHO_BITS = 64: a
+scheme with at least one tampered row passes both checks with
+probability <= ~2^-64 over the Fiat-Shamir coins (rho binds the A_k
+AND the S_u, so an adversary cannot correlate share tampering against
+fixed coefficients). This is a deliberately reduced prototype parameter
+— the RLC machinery everywhere else in the repo uses 128-bit rho — and
+the reason FSDKR_DELEGATE defaults OFF (see SECURITY.md "MSM
+delegation").
+
+Verdict bit-identity is structural: a missing certificate, partial row
+coverage, conflicting share points, or a FAILING certificate check all
+fall back to the honest per-row path for that scheme (and count
+`certs_rejected`/`fallback_rows`), so tampered transcripts produce
+exactly the honest arm's row verdicts in both knob positions — the
+delegated arm can only ever short-circuit schemes whose rows all pass.
+
+The certificate is broadcast-public (it rides the VSS scheme on the
+wire, serialization._vss_enc) and derives only from public values.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+from ..core.secp256k1 import (
+    GENERATOR,
+    N as CURVE_N,
+    P as CURVE_P,
+    Point,
+    _jac_to_affine,
+    _jadd,
+    _jdouble,
+)
+from ..core.transcript import Transcript
+
+__all__ = [
+    "RHO_BITS",
+    "delegate_enabled",
+    "rho_vec",
+    "emit_cert",
+    "try_delegate",
+    "honest_model_ops",
+    "stats",
+    "stats_reset",
+    "count",
+]
+
+RHO_BITS = 64
+
+_DOMAIN = b"fsdkr/msm-delegate/v1"
+
+
+def delegate_enabled() -> bool:
+    """FSDKR_DELEGATE gates the certificate arm on BOTH sides (cert
+    emission at distribute, cert checking at collect): default OFF —
+    the honest per-row MSM path — because the prototype soundness
+    parameter is 64-bit statistical (module docstring). Read at call
+    time so the bench battery and the CI legs can toggle it per step."""
+    return os.environ.get("FSDKR_DELEGATE", "0").lower() in (
+        "1", "on", "true", "yes",
+    )
+
+
+# ---------------------------------------------------------------------------
+# Delegation statistics (the `delegate` field of the bench JSON): schemes
+# and rows resolved by certificate, rejected certificates, actual counted
+# group ops of the delegated checks, and rows that fell back to the
+# honest path. Same registry-backed window view as backend.rlc.
+
+_EVENTS = (
+    "schemes_delegated", "rows_delegated", "certs_rejected",
+    "fallback_rows", "group_ops",
+)
+
+
+def _metric():
+    from ..telemetry import registry
+
+    return registry.counter(
+        "fsdkr_delegate_events",
+        "Feldman MSM-delegation statistics (proofs.msm_delegate)",
+        labelnames=("event",),
+    )
+
+
+def count(name: str, n: int = 1) -> None:
+    _metric().inc(n, event=name)
+
+
+def stats() -> Dict[str, int]:
+    m = _metric()
+    return {e: int(m.value(event=e)) for e in _EVENTS}
+
+
+def stats_reset() -> None:
+    _metric().reset()
+
+
+# ---------------------------------------------------------------------------
+
+
+def rho_vec(scheme, points: Sequence[Point], hash_alg=None) -> List[int]:
+    """Fiat-Shamir coefficients rho_u, u = 1..n, each in [1, 2^64-1].
+
+    The transcript binds the commitments A_k AND the public share
+    points S_u: were rho derived from the A_k alone, an adversary could
+    tamper shares in correlation against known coefficients
+    (S_1 += D, S_2 -= rho_1/rho_2 * D) and keep the linear combination
+    — binding the S_u re-randomizes every rho under any share edit.
+    Nonzero by the [1, 2^64-1] reduction, so no row ever drops out of
+    its own check."""
+    base = Transcript(_DOMAIN, algorithm=hash_alg)
+    for a_k in scheme.commitments:
+        base.chain_point(a_k)
+    for s_u in points:
+        base.chain_point(s_u)
+    seed = base.result_int()
+    out = []
+    for u in range(1, scheme.parameters.share_count + 1):
+        t = Transcript(_DOMAIN, algorithm=hash_alg)
+        t.chain_int(seed)
+        t.chain_int(u)
+        out.append(1 + t.result_challenge(RHO_BITS) % ((1 << RHO_BITS) - 1))
+    return out
+
+
+def emit_cert(scheme, shares, points: Sequence[Point], hash_alg=None) -> None:
+    """Prover-side certificate at distribute: the prover HOLDS the
+    shares f(u), so R = (sum_u rho_u * f(u) mod q) * G is one scalar
+    fold plus ONE fixed-base generator mul — constant-size, constant
+    work, attached in place as `scheme.delegate_cert` (rides the
+    existing VSS wire encoding; broadcast-public by design)."""
+    rho = rho_vec(scheme, points, hash_alg)
+    sigma = 0
+    for r, s in zip(rho, shares):
+        sigma += r * s.to_int()
+    scheme.delegate_cert = GENERATOR * (sigma % CURVE_N)
+
+
+# -- shared-chain wNAF multi-scalar multiplication with a REAL op counter
+
+_W = 4  # odd-multiple window width: {1,3,5,...,15}P per point
+
+
+def _wnaf(k: int) -> List[int]:
+    out = []
+    while k:
+        if k & 1:
+            d = k & ((1 << (_W + 1)) - 1)
+            if d >= (1 << _W):
+                d -= 1 << (_W + 1)
+            k -= d
+        else:
+            d = 0
+        out.append(d)
+        k >>= 1
+    return out
+
+
+def _msm(points: Sequence[Point], scalars: Sequence[int], ops: List[int]) -> Point:
+    """sum_i scalars[i] * points[i] on ONE shared doubling chain
+    (interleaved width-4 wNAF, Jacobian coordinates). `ops[0]` is
+    incremented for every group double/add actually executed — the
+    measured delegated-arm work of the acceptance A/B."""
+    tables = []
+    digit_vecs = []
+    for pt, k in zip(points, scalars):
+        k = int(k)
+        if k == 0 or pt.infinity:
+            continue
+        dbl = _jdouble(pt.x, pt.y, 1)
+        ops[0] += 1
+        tbl = [(pt.x, pt.y, 1)]
+        for _ in range((1 << (_W - 1)) - 1):
+            tbl.append(_jadd(*tbl[-1], *dbl))
+            ops[0] += 1
+        tables.append(tbl)
+        digit_vecs.append(_wnaf(k))
+    if not tables:
+        return Point.identity()
+    top = max(len(d) for d in digit_vecs)
+    rx, ry, rz = 0, 1, 0
+    for i in range(top - 1, -1, -1):
+        if rz != 0:
+            rx, ry, rz = _jdouble(rx, ry, rz)
+            ops[0] += 1
+        for tbl, digits in zip(tables, digit_vecs):
+            if i < len(digits) and digits[i]:
+                d = digits[i]
+                tx, ty, tz = tbl[(abs(d) - 1) >> 1]
+                if d < 0:
+                    ty = CURVE_P - ty
+                rx, ry, rz = _jadd(rx, ry, rz, tx, ty, tz)
+                ops[0] += 1
+    return _jac_to_affine(rx, ry, rz)
+
+
+def try_delegate(items, hash_alg=None) -> Optional[List[Optional[bool]]]:
+    """Certificate pre-pass over validate_feldman items
+    (scheme, public share point, 1-based index). Returns None when the
+    arm is disabled (the caller runs its honest path untouched);
+    otherwise a per-row list holding True for rows resolved by an
+    ACCEPTED certificate and None for rows the caller must still verify
+    honestly. Never returns False: a failing/missing certificate only
+    ever demotes its scheme to the honest path (verdict bit-identity
+    with FSDKR_DELEGATE=0 is structural — pinned by
+    tests/test_delegate.py, including forged certificates)."""
+    if not items or not delegate_enabled():
+        return None
+    out: List[Optional[bool]] = [None] * len(items)
+    groups: Dict[int, List[int]] = {}
+    for row, (scheme, _, _) in enumerate(items):
+        groups.setdefault(id(scheme), []).append(row)
+    for rows in groups.values():
+        scheme = items[rows[0]][0]
+        cert = getattr(scheme, "delegate_cert", None)
+        n = scheme.parameters.share_count
+        if cert is None or not scheme.commitments:
+            count("fallback_rows", len(rows))
+            continue
+        by_u: Dict[int, Point] = {}
+        consistent = True
+        for row in rows:
+            _, point, u = items[row]
+            prev = by_u.get(u)
+            if prev is not None and prev != point:
+                consistent = False  # same slot, different claimed points
+                break
+            by_u[u] = point
+        if not consistent or set(by_u) != set(range(1, n + 1)):
+            # the certificate covers ALL n shares of the scheme; a
+            # partial launch cannot check it and stays honest
+            count("fallback_rows", len(rows))
+            continue
+        s_points = [by_u[u] for u in range(1, n + 1)]
+        rho = rho_vec(scheme, s_points, hash_alg)
+        ops = [0]
+        s_side = _msm(s_points, rho, ops)
+        t1 = len(scheme.commitments)
+        c_vec = [0] * t1  # c_k = sum_u rho_u * u^k, PLAIN integers
+        for u in range(1, n + 1):
+            pw = rho[u - 1]
+            for k in range(t1):
+                c_vec[k] += pw
+                pw *= u
+        a_side = _msm(list(scheme.commitments), c_vec, ops)
+        count("group_ops", ops[0])
+        if s_side == cert and a_side == cert:
+            count("schemes_delegated")
+            count("rows_delegated", len(rows))
+            for row in rows:
+                out[row] = True
+        else:
+            count("certs_rejected")
+            count("fallback_rows", len(rows))
+    return out
+
+
+def honest_model_ops(items) -> int:
+    """Deterministic group-op model of the honest Feldman arm over the
+    same rows: per row, Horner sum_k A_k u^k is t steps of mul-by-u
+    plus add-A_k, with mul-by-u on a double-and-add chain costing
+    (bitlen(u)-1) doublings + (popcount(u)-1) additions. The A/B
+    publishes this count against the delegated arm's MEASURED ops —
+    a model (not wall-time) because the honest arm runs in native C
+    (native.ec.horner_batch), whose clock beats any Python MSM
+    regardless of op count; ops are the implementation-neutral
+    measure."""
+    total = 0
+    for scheme, _point, u in items:
+        t_steps = max(0, len(scheme.commitments) - 1)
+        per_step = (
+            max(0, u.bit_length() - 1)
+            + max(0, bin(u).count("1") - 1)
+            + 1
+        )
+        total += t_steps * per_step
+    return total

@@ -231,6 +231,20 @@ class RefreshMessage:
                 for p in per:
                     p["points"] = [GENERATOR * s for s in p["shares"]]
 
+        # FSDKR_DELEGATE: attach the 2G2T-style MSM-delegation
+        # certificate to each sender's VSS scheme (proofs.msm_delegate)
+        # — one fixed-base generator mul per sender, broadcast-public,
+        # checked by receivers instead of the per-share Horner MSMs.
+        from ..proofs import msm_delegate
+
+        if msm_delegate.delegate_enabled():
+            with phase("distribute.delegate_certs", items=len(per)):
+                for p in per:
+                    msm_delegate.emit_cert(
+                        p["scheme"], p["shares"], p["points"],
+                        config.hash_alg,
+                    )
+
         # ---- fully fused prover columns over all (sender, receiver)
         # pairs: the encryption column and BOTH proof families' stage-1
         # commitment columns share launches by exponent width (the
@@ -777,9 +791,22 @@ class RefreshMessage:
             pair_spans[s] = (lo, len(pdl_items))
 
         if pdl_items:
-            # both families share one fused launch set (verify_pairs)
+            # both families share one fused launch set (verify_pairs).
+            # The session->row-span map rides along so the fused call
+            # can amortize across sessions (cross-session dedup +
+            # session-first blame, tpu_verifier.verify_pairs) — but
+            # ONLY on the full fused call: fused_isolated's per-session
+            # retry slices are single-session, so spans would be stale
+            # there (detected by length).
+            def _pairs_call(p_slice, r_slice):
+                if len(p_slice) == len(pdl_items):
+                    return backend.verify_pairs(
+                        p_slice, r_slice, session_spans=pair_spans
+                    )
+                return backend.verify_pairs(p_slice, r_slice)
+
             pdl_verdicts, range_verdicts = fused_multi(
-                backend.verify_pairs, (pdl_items, range_items), pair_spans
+                _pairs_call, (pdl_items, range_items), pair_spans
             )
             # attribution in the reference's loop order (msg outer, i
             # inner; PDL before range — src/refresh_message.rs:330-350)

@@ -74,17 +74,25 @@ def _ek_dec(d: dict) -> EncryptionKey:
 
 
 def _vss_enc(v: VerifiableSS) -> dict:
-    return {
+    # the delegation certificate (proofs.msm_delegate, FSDKR_DELEGATE)
+    # is optional on the wire: the key is emitted ONLY when present, so
+    # certificate-free messages byte-match the pre-delegation encoding
+    out = {
         "threshold": v.parameters.threshold,
         "share_count": v.parameters.share_count,
         "commitments": [_point_enc(c) for c in v.commitments],
     }
+    if v.delegate_cert is not None:
+        out["delegate_cert"] = _point_enc(v.delegate_cert)
+    return out
 
 
 def _vss_dec(d: dict) -> VerifiableSS:
+    cert = d.get("delegate_cert")
     return VerifiableSS(
         parameters=ShamirSecretSharing(d["threshold"], d["share_count"]),
         commitments=[_point_dec(c) for c in d["commitments"]],
+        delegate_cert=_point_dec(cert) if cert is not None else None,
     )
 
 

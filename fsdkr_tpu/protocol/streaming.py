@@ -358,8 +358,18 @@ def _finalize_impl(streams, errors, config):
             range_items.extend(r_rows)
         pair_spans[s] = (lo, len(pdl_items))
     if pdl_items:
+        # spans ride only on the full fused call (cross-session dedup +
+        # session-first blame in tpu_verifier.verify_pairs); per-session
+        # retry slices are single-session, where spans would be stale
+        def _pairs_call(p_slice, r_slice):
+            if len(p_slice) == len(pdl_items):
+                return backend.verify_pairs(
+                    p_slice, r_slice, session_spans=pair_spans
+                )
+            return backend.verify_pairs(p_slice, r_slice)
+
         pdl_verdicts, range_verdicts = fused_isolated(
-            backend.verify_pairs, (pdl_items, range_items), pair_spans, errors
+            _pairs_call, (pdl_items, range_items), pair_spans, errors
         )
         for s, (lo, _hi) in pair_spans.items():
             if errors[s] is not None:

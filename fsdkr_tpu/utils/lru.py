@@ -64,6 +64,14 @@ class BudgetLRU:
             self.misses += 1
             return None
 
+    def peek(self, key) -> Optional[Any]:
+        """Presence probe that does NOT count as a hit/miss and does not
+        refresh recency — callers that only want to know whether a table
+        is already resident (the fold-ladder cache's deferred-build
+        heuristic) must not distort the bench battery's hit accounting."""
+        with self._lock:
+            return self._d.get(key)
+
     def put(self, key, value, nbytes: int) -> None:
         nbytes = max(1, int(nbytes))
         with self._lock:

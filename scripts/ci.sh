@@ -195,6 +195,79 @@ print("memory-plan leg ok:", mem["tiles"], "tiles, peak",
       mem["budget_bytes"])
 EOF
 
+echo "== test: cross-session fusion leg (fused S=4 identity + delegate A/B) =="
+# the smoke tier runs tests/test_xsession.py and tests/test_delegate.py
+# at TEST_CONFIG; this leg pins the ISSUE 17 acceptance invariants at a
+# fast 640-bit shape on every commit: a fused S=4 launch's verdicts,
+# blame, and adopted state are bit-identical to independent collects
+# (honest + one-tampered-of-four), full-width ladders run once per
+# merged group (not per session), and FSDKR_DELEGATE=0/1 agree on a
+# fixed honest AND tampered transcript with certs on the wire
+python - <<'EOF'
+import dataclasses, os
+from fsdkr_tpu.config import ProtocolConfig
+from fsdkr_tpu.backend import rlc
+from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+from fsdkr_tpu.protocol.serialization import local_key_to_json
+
+cfg = ProtocolConfig(
+    paillier_bits=640, m_security=32, correct_key_rounds=3
+).with_backend("tpu")
+os.environ["FSDKR_DELEGATE"] = "1"  # certs on the wire for the A/B
+keys = simulate_keygen(1, 3, cfg)
+out = RefreshMessage.distribute_batch([(k.i, k) for k in keys], 3, cfg)
+msgs = [m for m, _ in out]
+dk = out[0][1]
+os.environ["FSDKR_DELEGATE"] = "0"
+
+def collect_fused(use_msgs_per_s):
+    ks = [keys[0].clone() for _ in use_msgs_per_s]
+    errs = RefreshMessage.collect_sessions(
+        [(m, k, dk, ()) for m, k in zip(use_msgs_per_s, ks)], cfg
+    )
+    return errs, [local_key_to_json(k) for k in ks]
+
+# solo reference + fused honest S=4: verdicts and state bit-identical
+ref_errs, ref_states = collect_fused([msgs])
+assert ref_errs == [None], ref_errs
+rlc.stats_reset()
+errs, states = collect_fused([msgs] * 4)
+st = rlc.stats()
+assert errs == [None] * 4, errs
+assert states == ref_states * 4, "fused state diverged from solo collect"
+assert st["fullwidth_ladders"] == st["rlc_groups"] > 0, st
+assert st["xsession_rows_deduped"] > 0, st
+
+# one-tampered-of-four blames exactly the guilty session, bit-identical
+bad_pv = list(msgs[1].pdl_proof_vec)
+bad_pv[0] = dataclasses.replace(bad_pv[0], u2=bad_pv[0].u2 + 1)
+msgs_bad = list(msgs)
+msgs_bad[1] = dataclasses.replace(msgs[1], pdl_proof_vec=bad_pv)
+solo_err = collect_fused([msgs_bad])[0][0]
+errs, _ = collect_fused([msgs, msgs_bad, msgs, msgs])
+assert [e is None for e in errs] == [True, False, True, True], errs
+assert type(errs[1]) is type(solo_err) and str(errs[1]) == str(solo_err)
+
+# FSDKR_DELEGATE A/B on the same transcripts: verdict + state parity
+from fsdkr_tpu.proofs import msm_delegate
+assert all(m.coefficients_committed_vec.delegate_cert is not None
+           for m in msgs)
+os.environ["FSDKR_DELEGATE"] = "1"
+msm_delegate.stats_reset()
+errs_on, states_on = collect_fused([msgs] * 4)
+dstats = msm_delegate.stats()
+errs_bad_on, _ = collect_fused([msgs_bad])
+os.environ["FSDKR_DELEGATE"] = "0"
+assert errs_on == [None] * 4 and states_on == states, "delegate arm diverged"
+assert dstats["schemes_delegated"] == len(msgs), dstats
+assert dstats["rows_delegated"] > 0 and dstats["certs_rejected"] == 0, dstats
+assert type(errs_bad_on[0]) is type(solo_err)
+assert str(errs_bad_on[0]) == str(solo_err)
+print("cross-session fusion leg ok:", st["rlc_groups"], "merged groups,",
+      st["xsession_rows_deduped"], "rows deduped,",
+      dstats["rows_delegated"], "rows by certificate")
+EOF
+
 echo "== test: FSDKR_PRECOMPUTE=0 leg (inline prover path) =="
 # the smoke tier above ran with the default FSDKR_PRECOMPUTE=1 (pool
 # consume-or-compute in distribute); this leg forces the inline path on

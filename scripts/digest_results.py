@@ -87,6 +87,9 @@ def main():
         + sorted(root.glob("net_storm*.json"))
         + sorted(root.glob("cpu_scale_*.json"))
         + sorted(root.glob("cpu_full_*.json"))
+        + sorted(root.glob("amortization_*.json"))
+        + sorted(root.glob("delegate_ab*.json"))
+        + sorted(root.glob("net_full_param*.json"))
     )
     for path in paths:
         name = path.stem[2:] if path.stem.startswith("m_") else path.stem
@@ -160,6 +163,66 @@ def main():
                 "\n`proxy:` rows are reduced-parameter structural runs "
                 "(e.g. 768-bit/M=32 cpu_scale_n256*) or plan-only dry "
                 "runs — NOT full-parameter (2048-bit/M=256) numbers."
+            )
+        print()
+
+    amort = [(name, r) for name, r in configs if r.get("curve")]
+    if amort:
+        # cross-session amortization sweeps (ISSUE 17, BENCH_AMORTIZE):
+        # one committee, fused collect_sessions at each S — the reduced-
+        # parameter sweeps label as proxies like every other config row
+        print("### cross-session amortization "
+              "(bench.py BENCH_AMORTIZE, fused collect_sessions)\n")
+        for name, r in amort:
+            proxy = (
+                " — proxy: reduced parameters"
+                if is_structural_proxy(r) else ""
+            )
+            print(f"#### {name}: {r['metric']}{proxy}\n")
+            print("| S | warm s | s/session | proofs/s | vs S=1 "
+                  "| groups | fullwidth ladders | rows folded "
+                  "| deduped | ladder cache hit/miss |")
+            print("|---|---|---|---|---|---|---|---|---|---|")
+            for pt in r["curve"]:
+                print(
+                    f"| {pt.get('sessions')} | {pt.get('collect_warm_s')} "
+                    f"| {pt.get('per_session_warm_s')} "
+                    f"| {pt.get('proofs_per_s')} "
+                    f"| {pt.get('amortization_x', '—')}x "
+                    f"| {pt.get('rlc_groups')} "
+                    f"| {pt.get('fullwidth_ladders')} "
+                    f"| {pt.get('rows_folded')} "
+                    f"| {pt.get('xsession_rows_deduped')} "
+                    f"| {pt.get('ladder_cache_hits')}/"
+                    f"{pt.get('ladder_cache_misses')} |"
+                )
+            print()
+
+    delegated = [
+        (name, r) for name, r in configs if "delegated_measured_ops" in r
+    ]
+    if delegated:
+        # FSDKR_DELEGATE acceptance A/Bs (ISSUE 17): parity verdicts and
+        # the measured-vs-model group-op counts
+        print("### Feldman MSM delegation A/B "
+              "(bench.py BENCH_DELEGATE_AB)\n")
+        print("| step | shape | parity honest/tampered | delegated ops "
+              "| honest model ops | ratio | warm s honest/delegated "
+              "| schemes/rows by cert |")
+        print("|---|---|---|---|---|---|---|---|")
+        for name, r in delegated:
+            d = r.get("delegate") or {}
+            step = f"proxy: {name}" if is_structural_proxy(r) else name
+            print(
+                f"| {step} | {r['metric']} "
+                f"| {r.get('verdict_parity_honest')}/"
+                f"{r.get('verdict_parity_tampered')} "
+                f"| {r.get('delegated_measured_ops')} "
+                f"| {r.get('honest_model_ops')} | {r.get('ops_ratio')} "
+                f"| {r.get('collect_warm_honest_s')}/"
+                f"{r.get('collect_warm_delegated_s')} "
+                f"| {d.get('schemes_delegated')}/"
+                f"{d.get('rows_delegated')} |"
             )
         print()
 

@@ -249,6 +249,37 @@ run_local serve_sustained 3000 JAX_PLATFORMS=cpu \
   python scripts/loadgen.py --committees 200 --bases 4 --window 60 \
   --prefill-wait 90 --tag sustained
 
+# cross-session amortization curve (ISSUE 17): fused S=1/2/4/8/16
+# full-parameter sessions of ONE committee through collect_sessions —
+# per-S proofs/s, ladders-per-launch (must equal merged groups, never
+# groups x S), dedup counts, fold-ladder cache hits. Host-pinned so a
+# tunnel outage cannot eat the sweep; the acceptance gate is S=8
+# aggregate proofs/s >= 1.3x the S=1 rate.
+run_local amortization_curve 7200 BENCH_PLATFORM=cpu BENCH_N=16 \
+  BENCH_T=8 BENCH_AMORTIZE=1,2,4,8,16 python bench.py
+[ -e "$R/m_amortization_curve.ok" ] && \
+  cp "$R/m_amortization_curve.json" "$R/amortization_curve.json"
+
+# Feldman MSM-delegation acceptance A/B (ISSUE 17): FSDKR_DELEGATE=0/1
+# on the same fused S=4 full-parameter launch — bit-identical verdicts
+# on honest AND tampered transcripts, delegated measured group ops
+# strictly below the honest arm's op model.
+run_local delegate_ab 7200 BENCH_PLATFORM=cpu BENCH_N=16 BENCH_T=8 \
+  BENCH_DELEGATE_AB=1 BENCH_SESSIONS=4 python bench.py
+[ -e "$R/m_delegate_ab.ok" ] && \
+  cp "$R/m_delegate_ab.json" "$R/delegate_ab_full.json"
+
+# full-parameter committees over the socket ingress (ISSUE 17
+# satellite): the net storm harness at 2048-bit/M=256, n=16 — the
+# fused amortizing path fed by real TCP clients; sessions/s-per-core
+# lands next to the in-process baseline in the same report.
+run_local net_full_param 7200 JAX_PLATFORMS=cpu \
+  python scripts/loadgen.py --net --committees 4 --bases 2 --shards 2 \
+  --clients 2 --window 60 --rate 0.15 --baseline-window 45 \
+  --deadline 300 --kills 0 --seed 42 --drain-timeout 900 \
+  --bits 2048 --m-security 256 --n 16 --t 8 \
+  --out "$R/net_full_param.json"
+
 # north-star shape at FULL parameters (ISSUE 10 / ROADMAP item 3): the
 # n=256 / 2048-bit / M=256 end-to-end run under the memory plan. Pinned
 # to the host platform (run_local) so a tunnel outage cannot eat the

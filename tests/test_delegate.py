@@ -1,0 +1,223 @@
+"""FSDKR_DELEGATE — Feldman-MSM delegation A/B discipline (ISSUE 17
+tentpole (c), proofs/msm_delegate.py).
+
+The arm is gated on bit-identical verdicts in both knob positions, on
+honest AND tampered transcripts: a certificate can only ever
+short-circuit a scheme whose rows all pass, and every failure mode
+(forged certificate, missing certificate, tampered commitments or
+share points) demotes its scheme to the honest per-row path. The
+delegated verifier's measured group-op count must sit strictly below
+the honest arm's op model — the whole point of outsourcing the MSM.
+"""
+
+import dataclasses
+
+import pytest
+
+from fsdkr_tpu.core.secp256k1 import GENERATOR
+from fsdkr_tpu.proofs import msm_delegate
+from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+from fsdkr_tpu.protocol.serialization import (
+    local_key_to_json,
+    refresh_message_from_json,
+    refresh_message_to_json,
+)
+
+
+def _distribute(cfg, monkeypatch, delegate="1", t=1, n=3):
+    monkeypatch.setenv("FSDKR_DELEGATE", delegate)
+    keys = simulate_keygen(t, n, cfg)
+    res = RefreshMessage.distribute_batch([(k.i, k) for k in keys], n, cfg)
+    return keys, [m for m, _ in res], [dk for _, dk in res]
+
+
+def _collect(cfg, keys, msgs, dks):
+    k = keys[0].clone()
+    err = RefreshMessage.collect_sessions([(msgs, k, dks[0], ())], cfg)[0]
+    return err, local_key_to_json(k)
+
+
+# the tpu-backend variant cold-compiles the whole batched collect
+# pipeline (~3.5 min on the fallback platform), so it rides the slow
+# lane; scripts/ci.sh's fusion leg covers tpu-backend delegate A/B at
+# the fast 640-bit shape on every CI run.
+@pytest.mark.parametrize(
+    "backend", ["host", pytest.param("tpu", marks=pytest.mark.slow)]
+)
+def test_verdict_parity_honest(test_config, monkeypatch, backend):
+    """Certs emitted at distribute; collect agrees in both knob
+    positions, rows actually ride the certificate when enabled."""
+    cfg = test_config.with_backend(backend)
+    keys, msgs, dks = _distribute(cfg, monkeypatch)
+    assert all(
+        m.coefficients_committed_vec.delegate_cert is not None for m in msgs
+    )
+
+    msm_delegate.stats_reset()
+    err_on, state_on = _collect(cfg, keys, msgs, dks)
+    st = msm_delegate.stats()
+    assert err_on is None
+    assert st["schemes_delegated"] == len(msgs)
+    assert st["rows_delegated"] > 0 and st["certs_rejected"] == 0
+
+    monkeypatch.setenv("FSDKR_DELEGATE", "0")
+    msm_delegate.stats_reset()
+    err_off, state_off = _collect(cfg, keys, msgs, dks)
+    assert err_off is None
+    assert msm_delegate.stats()["schemes_delegated"] == 0
+    assert state_on == state_off
+
+
+def test_verdict_parity_tampered(test_config, monkeypatch):
+    """A tampered commitment vector fails identically in both arms —
+    the broken certificate check demotes the scheme to the honest path,
+    which raises exactly the honest arm's error."""
+    cfg = test_config
+    keys, msgs, dks = _distribute(cfg, monkeypatch)
+    vss = msgs[1].coefficients_committed_vec
+    bad_commits = list(vss.commitments)
+    bad_commits[0] = bad_commits[0] + GENERATOR
+    msgs_bad = list(msgs)
+    msgs_bad[1] = dataclasses.replace(
+        msgs[1],
+        coefficients_committed_vec=dataclasses.replace(
+            vss, commitments=bad_commits
+        ),
+    )
+
+    msm_delegate.stats_reset()
+    err_on, _ = _collect(cfg, keys, msgs_bad, dks)
+    assert msm_delegate.stats()["certs_rejected"] >= 1
+
+    monkeypatch.setenv("FSDKR_DELEGATE", "0")
+    err_off, _ = _collect(cfg, keys, msgs_bad, dks)
+    assert err_on is not None and err_off is not None
+    assert type(err_on) is type(err_off)
+    assert str(err_on) == str(err_off)
+
+
+def test_forged_certificate_rejected(test_config, monkeypatch):
+    """A forged certificate point never resolves rows: the scheme falls
+    back to the honest path (counted), and because the underlying rows
+    are honest the verdict stays clean — structural bit-identity."""
+    cfg = test_config
+    keys, msgs, dks = _distribute(cfg, monkeypatch)
+    vss = msgs[1].coefficients_committed_vec
+    msgs_forged = list(msgs)
+    msgs_forged[1] = dataclasses.replace(
+        msgs[1],
+        coefficients_committed_vec=dataclasses.replace(
+            vss, delegate_cert=GENERATOR * 0xDEADBEEF
+        ),
+    )
+
+    msm_delegate.stats_reset()
+    err, _ = _collect(cfg, keys, msgs_forged, dks)
+    st = msm_delegate.stats()
+    assert err is None
+    assert st["certs_rejected"] == 1
+    assert st["fallback_rows"] > 0
+    assert st["schemes_delegated"] == len(msgs) - 1
+
+
+def test_missing_certificate_falls_back(test_config, monkeypatch):
+    """Distribute with the arm off, collect with it on: no certs on the
+    wire, every scheme rides the honest path, verdict clean."""
+    cfg = test_config
+    keys, msgs, dks = _distribute(cfg, monkeypatch, delegate="0")
+    assert all(
+        m.coefficients_committed_vec.delegate_cert is None for m in msgs
+    )
+    monkeypatch.setenv("FSDKR_DELEGATE", "1")
+    msm_delegate.stats_reset()
+    err, _ = _collect(cfg, keys, msgs, dks)
+    st = msm_delegate.stats()
+    assert err is None
+    assert st["schemes_delegated"] == 0 and st["fallback_rows"] > 0
+
+
+def test_cert_survives_wire(test_config, monkeypatch):
+    """The certificate rides the canonical VSS encoding; a cert-free
+    message byte-matches the pre-delegation encoding."""
+    cfg = test_config
+    keys, msgs, dks = _distribute(cfg, monkeypatch)
+    rt = [refresh_message_from_json(refresh_message_to_json(m)) for m in msgs]
+    assert all(
+        m.coefficients_committed_vec.delegate_cert
+        == r.coefficients_committed_vec.delegate_cert
+        for m, r in zip(msgs, rt)
+    )
+    msm_delegate.stats_reset()
+    err, _ = _collect(cfg, keys, rt, dks)
+    assert err is None
+    assert msm_delegate.stats()["schemes_delegated"] == len(msgs)
+
+    monkeypatch.setenv("FSDKR_DELEGATE", "0")
+    _, msgs_plain, _ = _distribute(cfg, monkeypatch, delegate="0")
+    enc = refresh_message_to_json(msgs_plain[0])
+    assert "delegate_cert" not in enc
+
+
+def _synthetic_scheme(t, n):
+    """Full-parameter Feldman instance without the Paillier protocol
+    around it: the delegation economics are pure EC, so the op-count
+    inequality is pinned at the paper shape (n=16, t=8) directly."""
+    from fsdkr_tpu.core import vss
+    from fsdkr_tpu.core.secp256k1 import Scalar
+
+    scheme, shares = vss.share(t, n, Scalar.from_int(0x1234567))
+    points = [GENERATOR * s for s in shares]
+    return scheme, shares, points
+
+
+def test_delegated_ops_strictly_below_honest_model(monkeypatch):
+    """The acceptance inequality at the fused full-parameter launch
+    shape (n=16, t=8, S=4 sessions of one committee): measured group
+    ops of the delegated checks < the honest arm's per-row Horner op
+    model over the same rows. One certificate check resolves every
+    session's duplicate rows of a scheme, while the honest arm
+    evaluates all S x n Horner chains — the Feldman-side face of the
+    cross-session amortization the pair families get from value dedup.
+    (At S=1 the honest arm's tiny <=4-bit scalars make n=16 a near
+    wash; the delegate bench JSON publishes both shapes.)"""
+    monkeypatch.setenv("FSDKR_DELEGATE", "1")
+    t, n, s_sessions = 8, 16, 4
+    scheme, shares, points = _synthetic_scheme(t, n)
+    msm_delegate.emit_cert(scheme, shares, points)
+    items = [
+        (scheme, points[u - 1], u)
+        for _ in range(s_sessions)
+        for u in range(1, n + 1)
+    ]
+    msm_delegate.stats_reset()
+    pre = msm_delegate.try_delegate(items, None)
+    assert pre is not None and all(pre)
+    measured = msm_delegate.stats()["group_ops"]
+    model = msm_delegate.honest_model_ops(items)
+    assert 0 < measured < model, (measured, model)
+    # the certificate ran once, not once per session
+    assert msm_delegate.stats()["schemes_delegated"] == 1
+    assert msm_delegate.stats()["rows_delegated"] == s_sessions * n
+
+
+def test_tampered_share_point_rejected_by_cert(test_config, monkeypatch):
+    """Rho binds the share points: editing one S_u re-randomizes every
+    coefficient, so the certificate check fails and the honest path
+    catches the bad row — never a delegated false accept."""
+    cfg = test_config
+    keys, msgs, dks = _distribute(cfg, monkeypatch)
+    n = len(msgs)
+    items = [
+        (msg.coefficients_committed_vec, msg.points_committed_vec[i], i + 1)
+        for msg in msgs
+        for i in range(n)
+    ]
+    # tamper one claimed share point of scheme 0
+    items[1] = (items[1][0], items[1][1] + GENERATOR, items[1][2])
+    msm_delegate.stats_reset()
+    pre = msm_delegate.try_delegate(items, cfg.hash_alg)
+    st = msm_delegate.stats()
+    assert pre is not None
+    assert all(v is None for v in pre[:n])  # scheme 0 demoted entirely
+    assert all(pre[n:])  # untouched schemes still delegate
+    assert st["certs_rejected"] == 1

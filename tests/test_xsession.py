@@ -1,0 +1,226 @@
+"""Cross-session verify amortization (ISSUE 17 tentpole (a)/(b)).
+
+Fused multi-session `collect_sessions` launches merge pair-family RLC
+fold groups across sessions sharing a modulus family, dedup
+value-identical pair rows (FSDKR_XSESSION_DEDUP), and bisect failing
+merged groups session-first (backend.rlc.bisect_sessions). These tests
+pin the contract that makes all of that safe to ship:
+
+- verdicts AND adopted key state of a fused honest S-session launch are
+  bit-identical to S independent collects (n=3 here; the n=16
+  full-committee shape is `slow`);
+- one tampered session of four is blamed exactly, with the identical
+  error an independent collect raises, and healthy siblings stay clean
+  — in both dedup knob positions (dedup off routes the failure through
+  bisect_sessions);
+- the cross-launch fold-ladder cache (FSDKR_FOLD_CACHE,
+  backend.powm.fold_ladder2) goes mark -> build -> warm across
+  back-to-back launches, with hit/miss accounting in rlc.stats().
+"""
+
+import dataclasses
+
+import pytest
+
+from fsdkr_tpu.backend import rlc
+from fsdkr_tpu.protocol import RefreshMessage, simulate_keygen
+from fsdkr_tpu.protocol.serialization import local_key_to_json
+
+
+def _one_round(cfg, t=1, n=3, fresh=False):
+    keygen = getattr(simulate_keygen, "uncached", simulate_keygen) if fresh \
+        else simulate_keygen
+    keys = keygen(t, n, cfg)
+    res = RefreshMessage.distribute_batch([(k.i, k) for k in keys], n, cfg)
+    return keys, [m for m, _ in res], [dk for _, dk in res]
+
+
+def _adopted_state(key):
+    # full checkpoint surface: any divergence in rotated shares, adopted
+    # paillier keys, or commitments shows up here
+    return local_key_to_json(key)
+
+
+def _tpu(cfg):
+    return cfg.with_backend("tpu")
+
+
+def _fused_vs_independent(cfg, t, n, s_count):
+    keys, msgs, dks = _one_round(cfg, t, n)
+
+    solo_states = []
+    for _ in range(s_count):
+        k = keys[0].clone()
+        errs = RefreshMessage.collect_sessions([(msgs, k, dks[0], ())], cfg)
+        assert errs == [None], errs
+        solo_states.append(_adopted_state(k))
+    # determinism baseline: independent collects agree with each other
+    assert len(set(solo_states)) == 1
+
+    fused_keys = [keys[0].clone() for _ in range(s_count)]
+    rlc.stats_reset()
+    errs = RefreshMessage.collect_sessions(
+        [(msgs, k, dks[0], ()) for k in fused_keys], cfg
+    )
+    assert errs == [None] * s_count, errs
+    for k in fused_keys:
+        assert _adopted_state(k) == solo_states[0]
+    return rlc.stats()
+
+
+class TestFusedBitIdentity:
+    def test_fused_s4_matches_independent_n3(self, test_config):
+        st = _fused_vs_independent(_tpu(test_config), 1, 3, 4)
+        # the amortization claim itself: the fused launch ran its
+        # full-width ladders once per merged group, not once per
+        # (group, session)
+        assert st["fullwidth_ladders"] == st["rlc_groups"]
+        # same-committee sessions collapse through the value dedup
+        assert st["xsession_rows_deduped"] > 0
+
+    @pytest.mark.slow
+    def test_fused_s4_matches_independent_n16(self, test_config):
+        st = _fused_vs_independent(_tpu(test_config), 8, 16, 4)
+        assert st["fullwidth_ladders"] == st["rlc_groups"]
+
+    @pytest.mark.slow
+    def test_dedup_off_same_verdicts_and_state(self, test_config, monkeypatch):
+        cfg = _tpu(test_config)
+        keys, msgs, dks = _one_round(cfg)
+        k_on = [keys[0].clone() for _ in range(2)]
+        errs = RefreshMessage.collect_sessions(
+            [(msgs, k, dks[0], ()) for k in k_on], cfg
+        )
+        assert errs == [None, None]
+
+        monkeypatch.setenv("FSDKR_XSESSION_DEDUP", "0")
+        k_off = [keys[0].clone() for _ in range(2)]
+        rlc.stats_reset()
+        errs = RefreshMessage.collect_sessions(
+            [(msgs, k, dks[0], ()) for k in k_off], cfg
+        )
+        assert errs == [None, None]
+        assert rlc.stats()["xsession_rows_deduped"] == 0
+        assert {_adopted_state(k) for k in k_on} == {
+            _adopted_state(k) for k in k_off
+        }
+
+
+class TestSessionBlame:
+    @staticmethod
+    def _tampered_pdl(msgs):
+        """Session copy of the broadcast where one sender's PDL proof is
+        corrupted — fails in the pair-family RLC fold groups, the path
+        that actually merges across sessions."""
+        bad_pv = list(msgs[1].pdl_proof_vec)
+        bad_pv[0] = dataclasses.replace(bad_pv[0], u2=bad_pv[0].u2 + 1)
+        out = list(msgs)
+        out[1] = dataclasses.replace(msgs[1], pdl_proof_vec=bad_pv)
+        return out
+
+    # the dedup-off variant recompiles the non-merged fold path from
+    # cold (~100 s on the fallback platform) — slow lane; the dedup-on
+    # default path stays in tier-1.
+    @pytest.mark.parametrize(
+        "dedup", ["1", pytest.param("0", marks=pytest.mark.slow)]
+    )
+    def test_one_tampered_of_four_blames_guilty(
+        self, test_config, monkeypatch, dedup
+    ):
+        monkeypatch.setenv("FSDKR_XSESSION_DEDUP", dedup)
+        cfg = _tpu(test_config)
+        keys, msgs, dks = _one_round(cfg)
+        msgs_bad = self._tampered_pdl(msgs)
+
+        rlc.stats_reset()
+        out = RefreshMessage.collect_sessions(
+            [
+                (msgs_bad if s == 2 else msgs, keys[0].clone(), dks[0], ())
+                for s in range(4)
+            ],
+            cfg,
+        )
+        assert [out[s] is None for s in range(4)] == [True, True, False, True]
+        if dedup == "0":
+            # merged-group failure resolved session-first
+            assert rlc.stats()["session_bisects"] > 0
+
+        # blame is bit-identical to an independent collect of the
+        # guilty session (same exception type, same per-equation bits)
+        ref = RefreshMessage.collect_sessions(
+            [(msgs_bad, keys[0].clone(), dks[0], ())], cfg
+        )[0]
+        assert type(out[2]) is type(ref)
+        assert str(out[2]) == str(ref)
+
+    def test_tampered_range_blamed_exactly(self, test_config):
+        cfg = _tpu(test_config)
+        keys, msgs, dks = _one_round(cfg)
+        bad_rp = list(msgs[1].range_proofs)
+        bad_rp[0] = dataclasses.replace(bad_rp[0], z=bad_rp[0].z + 1)
+        msgs_bad = list(msgs)
+        msgs_bad[1] = dataclasses.replace(msgs[1], range_proofs=bad_rp)
+
+        out = RefreshMessage.collect_sessions(
+            [
+                (msgs_bad if s == 1 else msgs, keys[0].clone(), dks[0], ())
+                for s in range(3)
+            ],
+            cfg,
+        )
+        assert out[0] is None and out[2] is None
+        ref = RefreshMessage.collect_sessions(
+            [(msgs_bad, keys[0].clone(), dks[0], ())], cfg
+        )[0]
+        assert str(out[1]) == str(ref)
+
+
+@pytest.mark.fresh_committees
+def test_ladder_cache_warms_across_launches(test_config, monkeypatch):
+    """FSDKR_FOLD_CACHE lifecycle on a cold committee: launch 1 marks
+    the shared (h1, h2) base pairs (miss, Straus fallback), launch 2
+    builds the comb tables (miss), launch 3 applies them warm (hit).
+    Host route only — the device joint ladder has no persistent tables
+    — so FSDKR_DEVICE_POWM is forced off (conftest forces it on)."""
+    from fsdkr_tpu import native
+
+    if not native.available():
+        pytest.skip("fold-ladder cache needs the native comb engine")
+    monkeypatch.setenv("FSDKR_DEVICE_POWM", "0")
+    cfg = _tpu(test_config)
+    # fresh committee: cached committees' base pairs may already be
+    # marked/built by earlier launches in the process
+    keys, msgs, dks = _one_round(cfg, fresh=True)
+
+    seen = []
+    for _ in range(3):
+        rlc.stats_reset()
+        k = keys[0].clone()
+        errs = RefreshMessage.collect_sessions([(msgs, k, dks[0], ())], cfg)
+        assert errs == [None]
+        st = rlc.stats()
+        seen.append((st["ladder_cache_hits"], st["ladder_cache_misses"]))
+
+    assert seen[0][0] == 0 and seen[0][1] > 0  # cold: marked, all miss
+    assert seen[1][0] == 0 and seen[1][1] > 0  # second: table build
+    assert seen[2][0] > 0 and seen[2][1] == 0  # warm: served from cache
+
+
+def test_fold_cache_off_matches_on(test_config, monkeypatch):
+    """FSDKR_FOLD_CACHE=0 (multi_powm fallback) and =1 agree on verdicts
+    and adopted state — the cache is a routing decision, not math."""
+    monkeypatch.setenv("FSDKR_DEVICE_POWM", "0")
+    cfg = _tpu(test_config)
+    keys, msgs, dks = _one_round(cfg)
+
+    k_on = keys[0].clone()
+    assert RefreshMessage.collect_sessions(
+        [(msgs, k_on, dks[0], ())], cfg
+    ) == [None]
+
+    monkeypatch.setenv("FSDKR_FOLD_CACHE", "0")
+    k_off = keys[0].clone()
+    assert RefreshMessage.collect_sessions(
+        [(msgs, k_off, dks[0], ())], cfg
+    ) == [None]
+    assert _adopted_state(k_on) == _adopted_state(k_off)
